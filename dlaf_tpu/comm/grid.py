@@ -1,0 +1,94 @@
+"""2D device grid over a JAX mesh.
+
+TPU-native analogue of ``dlaf::comm::CommunicatorGrid``
+(reference: include/dlaf/communication/communicator_grid.h:37-161).  The
+reference reorders an MPI world into a row-major 2D grid and hands out
+row/col/full communicator pipelines; here the grid IS a
+``jax.sharding.Mesh`` with axes ``('r', 'c')`` and "row/col communicators"
+are just collectives over one mesh axis inside ``shard_map``.  Communicator
+clones/pipelines (ordering of MPI ops) have no analogue: XLA programs are
+totally ordered per device, and collectives over disjoint axes are scheduled
+by the compiler (SURVEY §5 "Distributed communication backend").
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlaf_tpu.common.index import Index2D, Size2D
+
+ROW_AXIS = "r"
+COL_AXIS = "c"
+
+
+class Grid:
+    """A ``Pr x Pc`` device grid.
+
+    ``mesh`` axes are ``('r', 'c')`` — mesh axis 'r' enumerates grid rows
+    (like the reference's row-major rank ordering,
+    communicator_grid.h "row-major order").
+    """
+
+    def __init__(self, mesh: Mesh):
+        if tuple(mesh.axis_names) != (ROW_AXIS, COL_AXIS):
+            raise ValueError(f"grid mesh must have axes ('r','c'), got {mesh.axis_names}")
+        self.mesh = mesh
+
+    @classmethod
+    def create(
+        cls,
+        shape: Optional[Size2D] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> "Grid":
+        """Build a grid over ``devices`` (default: all). Default shape is the
+        most-square ``Pr x Pc`` factorization with ``Pr <= Pc``."""
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        if shape is None:
+            pr = int(np.floor(np.sqrt(n)))
+            while n % pr:
+                pr -= 1
+            shape = Size2D(pr, n // pr)
+        shape = Size2D(*shape)
+        if shape.count() > n:
+            raise ValueError(f"grid {shape} needs {shape.count()} devices, have {n}")
+        dev = np.asarray(devices[: shape.count()]).reshape(shape.rows, shape.cols)
+        return cls(Mesh(dev, (ROW_AXIS, COL_AXIS)))
+
+    @classmethod
+    def local(cls) -> "Grid":
+        """1x1 grid on the default device (reference: local algorithm variants
+        take no grid; we unify by using a trivial grid)."""
+        return cls.create(Size2D(1, 1), [jax.devices()[0]])
+
+    @property
+    def grid_size(self) -> Size2D:
+        return Size2D(self.mesh.shape[ROW_AXIS], self.mesh.shape[COL_AXIS])
+
+    @property
+    def size(self) -> int:
+        return self.grid_size.count()
+
+    def rank_device(self, rank: Index2D) -> jax.Device:
+        return self.mesh.devices[rank[0], rank[1]]
+
+    def stacked_sharding(self) -> NamedSharding:
+        """Sharding for stacked local-tile arrays [Pr, Pc, ltr, ltc, mb, nb]."""
+        return NamedSharding(self.mesh, P(ROW_AXIS, COL_AXIS))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def row_sharding(self) -> NamedSharding:
+        """Sharding for per-grid-row arrays [Pr, ...] (replicated over cols)."""
+        return NamedSharding(self.mesh, P(ROW_AXIS))
+
+    def col_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(COL_AXIS))
+
+    def __repr__(self):
+        return f"Grid({self.grid_size.rows}x{self.grid_size.cols})"

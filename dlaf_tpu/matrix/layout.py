@@ -1,0 +1,70 @@
+"""Pack/unpack between global (row/col element) layout and the stacked
+block-cyclic local-tile layout.
+
+TPU-native replacement for the reference's allocation layouts
+(reference: include/dlaf/matrix/allocation.h, col_major_layout.h): instead
+of per-rank col-major/tile-compact buffers addressed through ``Distribution``,
+the whole distributed matrix is ONE array
+
+    X[Pr, Pc, ltr, ltc, mb, nb]
+
+sharded ``P('r','c')`` over the device mesh, where ``X[r, c, li, lj]`` is the
+tile with global tile index ``(li*Pr + r - sr, lj*Pc + c - sc)`` (block-cyclic
+with source rank ``(sr, sc)``).  Pack/unpack are pure reshape/transpose/roll,
+so they are jittable — under ``jit`` XLA lowers a resharding between a plain
+2D-sharded global array and this layout to an all-to-all over the mesh, which
+replaces the reference's explicit redistribution communication.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from dlaf_tpu.matrix.distribution import Distribution
+
+
+def pad_global(a, dist: Distribution):
+    """Pad an (m, n) global array to the uniform padded extent."""
+    m, n = dist.size
+    mp, np_ = dist.padded_size
+    if a.shape != (m, n):
+        raise ValueError(f"array shape {a.shape} != distribution size {(m, n)}")
+    xp = jnp if isinstance(a, jnp.ndarray) else np
+    if (mp, np_) == (m, n):
+        return a
+    return xp.pad(a, ((0, mp - m), (0, np_ - n)))
+
+
+def unpad_global(a, dist: Distribution):
+    m, n = dist.size
+    return a[:m, :n]
+
+
+def pack(a_padded, dist: Distribution):
+    """Global padded (Mp, Np) -> stacked [Pr, Pc, ltr, ltc, mb, nb]."""
+    pr, pc = dist.grid_size
+    ltr, ltc = dist.local_slots
+    mb, nb = dist.block_size
+    sr, sc = dist.source_rank
+    xp = jnp if isinstance(a_padded, jnp.ndarray) else np
+    x = a_padded.reshape(ltr, pr, mb, ltc, pc, nb).transpose(1, 4, 0, 3, 2, 5)
+    if sr:
+        x = xp.roll(x, sr, axis=0)
+    if sc:
+        x = xp.roll(x, sc, axis=1)
+    return x
+
+
+def unpack(x, dist: Distribution):
+    """Stacked [Pr, Pc, ltr, ltc, mb, nb] -> global padded (Mp, Np)."""
+    pr, pc = dist.grid_size
+    ltr, ltc = dist.local_slots
+    mb, nb = dist.block_size
+    sr, sc = dist.source_rank
+    mp, np_ = dist.padded_size
+    xp = jnp if isinstance(x, jnp.ndarray) else np
+    if sr:
+        x = xp.roll(x, -sr, axis=0)
+    if sc:
+        x = xp.roll(x, -sc, axis=1)
+    return x.transpose(2, 0, 4, 3, 1, 5).reshape(mp, np_)

@@ -1,0 +1,147 @@
+"""Distributed matrix on a 2D device grid.
+
+TPU-native analogue of ``dlaf::matrix::Matrix<T, Device>``
+(reference: include/dlaf/matrix/matrix.h:62-630).  The reference Matrix owns a
+``Distribution`` plus one async pipeline per local tile — the pipelines ARE
+its dependency system.  Here dependencies are XLA program order, so the matrix
+is just ``Distribution`` + one stacked device array
+``data[Pr, Pc, ltr, ltc, mb, nb]`` sharded ``P('r','c')`` over the grid mesh
+(see layout.py).  ``read()/readwrite()`` tile senders have no analogue;
+algorithms consume ``data`` inside ``shard_map``/``jit`` and return new
+arrays (functional style), with input donation providing in-place behavior.
+
+Host-side convenience accessors (``set_tile``/``get_tile``/``to_global``) are
+for tests and I/O, mirroring the reference test utilities
+(test/include/dlaf_test/matrix/util_matrix.h).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index import Index2D, Size2D
+from dlaf_tpu.matrix import layout
+from dlaf_tpu.matrix.distribution import Distribution
+
+
+class DistributedMatrix:
+    """A dense ``m x n`` matrix, 2D block-cyclic over ``grid``.
+
+    ``data`` holds every local tile of every rank, stacked:
+    ``data[r, c, li, lj]`` is the ``mb x nb`` tile with global tile index
+    ``dist.global_tile_from_local((li, lj), (r, c))``; slots past the edge are
+    zero-padded (uniform extents across ranks — SURVEY §7 "block-cyclic as
+    library-level bookkeeping over an even shard").
+    """
+
+    def __init__(self, dist: Distribution, grid: Grid, data: jax.Array):
+        if dist.grid_size != grid.grid_size:
+            raise ValueError(f"distribution grid {dist.grid_size} != device grid {grid.grid_size}")
+        expect = self.stacked_shape(dist)
+        if tuple(data.shape) != expect:
+            raise ValueError(f"data shape {data.shape}, expected {expect}")
+        self.dist = dist
+        self.grid = grid
+        self.data = data
+
+    # --- geometry -----------------------------------------------------------
+    @staticmethod
+    def stacked_shape(dist: Distribution):
+        pr, pc = dist.grid_size
+        ltr, ltc = dist.local_slots
+        mb, nb = dist.block_size
+        return (pr, pc, ltr, ltc, mb, nb)
+
+    @property
+    def size(self) -> Size2D:
+        return self.dist.size
+
+    @property
+    def block_size(self) -> Size2D:
+        return self.dist.block_size
+
+    @property
+    def nr_tiles(self) -> Size2D:
+        return self.dist.nr_tiles
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    # --- constructors --------------------------------------------------------
+    @classmethod
+    def zeros(
+        cls, grid: Grid, size, block_size, dtype=jnp.float32, source_rank=(0, 0)
+    ) -> "DistributedMatrix":
+        dist = Distribution(Size2D(*size), Size2D(*block_size), grid.grid_size, Index2D(*source_rank))
+        data = jnp.zeros(cls.stacked_shape(dist), dtype=dtype)
+        data = jax.device_put(data, grid.stacked_sharding())
+        return cls(dist, grid, data)
+
+    @classmethod
+    def from_global(
+        cls, grid: Grid, a, block_size, source_rank=(0, 0)
+    ) -> "DistributedMatrix":
+        """Distribute a host/global (m, n) array (pads, packs, places)."""
+        a = np.asarray(a)
+        dist = Distribution(
+            Size2D(*a.shape), Size2D(*block_size), grid.grid_size, Index2D(*source_rank)
+        )
+        x = layout.pack(layout.pad_global(a, dist), dist)
+        data = jax.device_put(jnp.asarray(x), grid.stacked_sharding())
+        return cls(dist, grid, data)
+
+    @classmethod
+    def from_element_function(
+        cls,
+        grid: Grid,
+        size,
+        block_size,
+        el: Callable[[np.ndarray, np.ndarray], np.ndarray],
+        dtype=jnp.float32,
+        source_rank=(0, 0),
+    ) -> "DistributedMatrix":
+        """Initialize from an element function ``el(i, j)`` evaluated on global
+        indices (vectorized).  Mirrors the reference test-harness ``set(matrix,
+        el)`` (test/include/dlaf_test/matrix/util_matrix.h)."""
+        m, n = Size2D(*size)
+        i, j = np.meshgrid(np.arange(m), np.arange(n), indexing="ij")
+        a = np.asarray(el(i, j), dtype=np.dtype(dtype)) if m and n else np.zeros((m, n), np.dtype(dtype))
+        return cls.from_global(grid, a.astype(np.dtype(dtype)), block_size, source_rank)
+
+    def like(self, data: Optional[jax.Array] = None) -> "DistributedMatrix":
+        return DistributedMatrix(self.dist, self.grid, self.data if data is None else data)
+
+    # --- host-side access (tests / IO) ---------------------------------------
+    def to_global(self) -> np.ndarray:
+        """Gather the full matrix to host (reference: test util ``gather``)."""
+        x = np.asarray(jax.device_get(self.data))
+        return np.asarray(layout.unpad_global(layout.unpack(x, self.dist), self.dist))
+
+    def get_tile(self, gt) -> np.ndarray:
+        gt = Index2D(*gt)
+        r, c = self.dist.rank_global_tile(gt)
+        li, lj = self.dist.local_tile_index(gt)
+        t = np.asarray(jax.device_get(self.data[r, c, li, lj]))
+        ts = self.dist.tile_size_of(gt)
+        return t[: ts.rows, : ts.cols]
+
+    def set_tile(self, gt, value: np.ndarray) -> None:
+        gt = Index2D(*gt)
+        r, c = self.dist.rank_global_tile(gt)
+        li, lj = self.dist.local_tile_index(gt)
+        ts = self.dist.tile_size_of(gt)
+        mb, nb = self.dist.block_size
+        buf = np.zeros((mb, nb), dtype=self.data.dtype)
+        buf[: ts.rows, : ts.cols] = value
+        self.data = self.data.at[r, c, li, lj].set(jnp.asarray(buf))
+
+    def __repr__(self):
+        return (
+            f"DistributedMatrix({self.size.rows}x{self.size.cols}, "
+            f"tiles {self.block_size.rows}x{self.block_size.cols}, grid {self.grid})"
+        )

@@ -1,0 +1,51 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's fixed 6-rank MPI test fixture
+(reference: test/include/dlaf_test/comm_grids/grids_6_ranks.h:26-60) — we use
+8 virtual devices so square-ish (2x4, 4x2), degenerate (1x1, 2x1) and
+non-divisible grids are all exercised on one host.  Must set XLA flags before
+jax initializes its backends, hence module-level os.environ mutation here.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the axon/TPU tunnel may be set
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU tunnel platform; override it
+# after import but before backend initialization so tests run on the virtual
+# 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+from dlaf_tpu.comm.grid import Grid  # noqa: E402
+from dlaf_tpu.common.index import Size2D  # noqa: E402
+
+
+def _grids():
+    """Grid fixture set: analogue of CommGridsEnvironment's {3x2 row-major,
+    2x3 col-major, 3x1, 1x2, 1x1} on 6 ranks — here on 8 devices."""
+    devs = jax.devices()
+    shapes = [(2, 4), (4, 2), (2, 2), (1, 2), (2, 1), (1, 1)]
+    return [Grid.create(Size2D(*s), devs) for s in shapes]
+
+
+@pytest.fixture(scope="session")
+def comm_grids():
+    return _grids()
+
+
+@pytest.fixture(scope="session")
+def grid_2x4():
+    return Grid.create(Size2D(2, 4), jax.devices())
+
+
+@pytest.fixture(scope="session")
+def grid_1x1():
+    return Grid.create(Size2D(1, 1), jax.devices()[:1])

@@ -1,0 +1,134 @@
+"""Distribution index-algebra tests.
+
+Ported case structure from reference test/unit/matrix/test_distribution.cpp:
+constructor geometry, ownership, global<->local conversions, ragged edges,
+degenerate sizes, source-rank offsets — validated against a brute-force
+block-cyclic oracle.
+"""
+import numpy as np
+import pytest
+
+from dlaf_tpu.common.index import Index2D, Size2D, iterate_range2d
+from dlaf_tpu.matrix.distribution import Distribution
+
+
+def oracle_owner(i, src, grid):
+    return (i + src) % grid
+
+
+PARAMS = [
+    # size, block, grid, src  (mix of divisible / ragged / degenerate, like
+    # the reference `sizes` lists incl. m=0, m<=mb, non-divisible)
+    ((0, 0), (4, 4), (2, 3), (0, 0)),
+    ((5, 7), (8, 8), (1, 1), (0, 0)),
+    ((13, 13), (4, 4), (2, 3), (0, 0)),
+    ((16, 24), (4, 4), (2, 3), (1, 2)),
+    ((23, 17), (5, 3), (3, 2), (2, 1)),
+    ((100, 60), (16, 16), (2, 4), (0, 3)),
+    ((4, 4), (8, 8), (2, 2), (1, 1)),
+]
+
+
+@pytest.mark.parametrize("size,block,grid,src", PARAMS)
+def test_geometry(size, block, grid, src):
+    d = Distribution(size, block, grid, src)
+    mt = -(-size[0] // block[0])
+    nt = -(-size[1] // block[1])
+    assert d.nr_tiles == Size2D(mt, nt)
+    # every global tile's size; sum of tile sizes == matrix size
+    rows = sum(d.tile_size_of((i, 0)).rows for i in range(mt))
+    cols = sum(d.tile_size_of((0, j)).cols for j in range(nt))
+    assert rows == size[0] and cols == size[1]
+
+
+@pytest.mark.parametrize("size,block,grid,src", PARAMS)
+def test_ownership_and_roundtrip(size, block, grid, src):
+    d = Distribution(size, block, grid, src)
+    mt, nt = d.nr_tiles
+    for gt in iterate_range2d((mt, nt)):
+        rank = d.rank_global_tile(gt)
+        assert rank.row == oracle_owner(gt.row, src[0], grid[0])
+        assert rank.col == oracle_owner(gt.col, src[1], grid[1])
+        lt = d.local_tile_index(gt)
+        assert d.global_tile_from_local(lt, rank) == gt
+        # next_local_tile at an owned tile equals local index
+        assert d.next_local_tile_from_global_tile(gt, rank) == lt
+
+
+@pytest.mark.parametrize("size,block,grid,src", PARAMS)
+def test_local_nr_tiles_counts(size, block, grid, src):
+    d = Distribution(size, block, grid, src)
+    mt, nt = d.nr_tiles
+    total = 0
+    for r in range(grid[0]):
+        for c in range(grid[1]):
+            ln = d.local_nr_tiles((r, c))
+            # count by brute force
+            cnt_r = sum(1 for i in range(mt) if oracle_owner(i, src[0], grid[0]) == r)
+            cnt_c = sum(1 for j in range(nt) if oracle_owner(j, src[1], grid[1]) == c)
+            assert ln == Size2D(cnt_r, cnt_c)
+            total += ln.count()
+    assert total == mt * nt
+
+
+@pytest.mark.parametrize("size,block,grid,src", PARAMS)
+def test_element_conversions(size, block, grid, src):
+    d = Distribution(size, block, grid, src)
+    rng = np.random.default_rng(0)
+    m, n = size
+    if m == 0 or n == 0:
+        return
+    for _ in range(20):
+        ge = Index2D(int(rng.integers(m)), int(rng.integers(n)))
+        gt = d.global_tile_index(ge)
+        el = d.tile_element_index(ge)
+        assert d.global_element_index(gt, el) == ge
+        ts = d.tile_size_of(gt)
+        assert el.row < ts.rows and el.col < ts.cols
+        assert d.rank_global_element(ge) == d.rank_global_tile(gt)
+
+
+def test_local_slots_uniform_padding():
+    d = Distribution((13, 13), (4, 4), (2, 3), (0, 0))
+    # 4x4 tile grid over 2x3: ltr = ceil(4/2) = 2, ltc = ceil(4/3) = 2
+    assert d.local_slots == Size2D(2, 2)
+    assert d.padded_size == Size2D(2 * 2 * 4, 2 * 3 * 4)
+    # local slots upper-bound every rank's true local count
+    for r in range(2):
+        for c in range(3):
+            ln = d.local_nr_tiles((r, c))
+            assert ln.rows <= d.local_slots.rows and ln.cols <= d.local_slots.cols
+
+
+def test_local_size():
+    d = Distribution((10, 10), (3, 3), (2, 2), (0, 0))
+    tot = 0
+    for r in range(2):
+        for c in range(2):
+            ls = d.local_size((r, c))
+            tot += ls.rows * ls.cols if False else 0
+    # row extents across ranks sum to m (per column of grid)
+    assert sum(d.local_size((r, 0)).rows for r in range(2)) == 10
+    assert sum(d.local_size((0, c)).cols for c in range(2)) == 10
+
+
+def test_sub_distribution():
+    d = Distribution((24, 24), (4, 4), (2, 3), (0, 0))
+    s = d.sub_distribution((8, 12), (16, 12))
+    assert s.size == Size2D(16, 12)
+    # tile (0,0) of sub == tile (2,3) of parent: owner must match
+    assert s.rank_global_tile((0, 0)) == d.rank_global_tile((2, 3))
+    assert s.rank_global_tile((1, 2)) == d.rank_global_tile((3, 5))
+    with pytest.raises(ValueError):
+        d.sub_distribution((3, 0), (4, 4))
+    with pytest.raises(ValueError):
+        d.sub_distribution((20, 20), (8, 8))
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Distribution((4, 4), (0, 4))
+    with pytest.raises(ValueError):
+        Distribution((4, 4), (4, 4), (2, 2), (2, 0))
+    with pytest.raises(ValueError):
+        Distribution((-1, 4), (4, 4))
