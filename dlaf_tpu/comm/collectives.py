@@ -1,0 +1,126 @@
+"""Collective primitives over the 2D grid, used inside ``shard_map``.
+
+TPU-native replacement for the reference's async tile collectives
+(reference: include/dlaf/communication/kernels/{all_reduce,broadcast,reduce,
+p2p,p2p_allsum}.h and broadcast_panel.h).  Correspondence:
+
+  schedule_bcast_send/recv      -> ``bcast`` (psum of root-masked data)
+  scheduleAllReduce             -> ``lax.psum`` over a mesh axis
+  scheduleSend/Recv ring        -> ``shift`` (lax.ppermute)
+  broadcast_panel col->row      -> ``transpose_panel`` (the diagonal-crossing
+                                   trick of broadcast_panel.h:30-189 becomes a
+                                   masked gather + psum over the row axis)
+
+Communicator pipelines/clones and MPI message ordering (communicator
+pipelines, §2.4 of SURVEY.md) have no analogue: XLA orders collectives by
+data flow and schedules independent ones concurrently.
+
+All functions assume they run inside ``shard_map`` over a mesh with axes
+``('r', 'c')`` (see grid.ROW_AXIS/COL_AXIS).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+
+
+def my_rank():
+    """(row, col) coords of this device in the grid (traced scalars)."""
+    return lax.axis_index(ROW_AXIS), lax.axis_index(COL_AXIS)
+
+
+def grid_shape():
+    return lax.axis_size(ROW_AXIS), lax.axis_size(COL_AXIS)
+
+
+def bcast(x, root, axis: str):
+    """Broadcast ``x`` from the device with ``axis_index(axis) == root`` to
+    all devices along ``axis``.  ``root`` may be traced.
+
+    Implemented as a psum of root-masked data: O(log P) on ICI, no explicit
+    send/recv pairing (replaces schedule_bcast_send/recv)."""
+    me = lax.axis_index(axis)
+    zero = jnp.zeros_like(x)
+    return lax.psum(jnp.where(me == root, x, zero), axis)
+
+
+def bcast2d(x, root_r, root_c):
+    """Broadcast from grid rank (root_r, root_c) to the full grid."""
+    return bcast(bcast(x, root_c, COL_AXIS), root_r, ROW_AXIS)
+
+
+def psum_axis(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def shift(x, axis: str, offset: int = 1):
+    """Ring shift along a grid axis: device i receives the value from device
+    ``(i - offset) % P`` (replaces p2p send/recv chains; lax.ppermute rides
+    ICI neighbor links)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_gather_axis(x, axis: str):
+    """Gather local blocks along an axis; result has a new leading axis of
+    size P ordered by axis index."""
+    return lax.all_gather(x, axis)
+
+
+def select_local_tiles(panel_global, local_count: int, grid_dim, my_coord, src=0):
+    """From a globally-indexed tile stack ``panel_global[nt_pad, ...]`` take
+    this rank's block-cyclic subset ``[local_count, ...]``
+    (tile ``lt`` -> global ``lt*P + (my - src) % P``)."""
+    idx = jnp.arange(local_count) * grid_dim + (my_coord - src) % grid_dim
+    return jnp.take(panel_global, idx, axis=0)
+
+
+def transpose_panel(cp, nr_row_tiles, ltc: int):
+    """Column panel -> row panel redistribution.
+
+    ``cp[ltr, mb, nb]`` holds (after a col-axis broadcast) the panel tiles for
+    this rank-row's global row-tiles ``i = li*Pr + myr``.  Returns
+    ``rp[ltc, mb, nb]`` with ``rp[lj] = panel tile of global index
+    j = lj*Pc + myc`` (zero where ``j >= nr_row_tiles``), i.e. the panel
+    re-distributed along each rank's *column* ownership — the TPU analogue of
+    the transposed-panel broadcast (reference broadcast_panel.h:116-189).
+
+    Cost: one psum over the row axis of ``ltc`` tiles.
+    """
+    myr, myc = my_rank()
+    pr, pc = grid_shape()
+    ltr = cp.shape[0]
+    jv = jnp.arange(ltc) * pc + myc  # global tile index wanted at each slot
+    src_slot = jnp.clip(jv // pr, 0, ltr - 1)
+    have = (jv % pr == myr) & (jv < nr_row_tiles)
+    contrib = jnp.where(
+        have.reshape((ltc,) + (1,) * (cp.ndim - 1)), jnp.take(cp, src_slot, axis=0), 0
+    )
+    return lax.psum(contrib, ROW_AXIS)
+
+
+def spmd(grid, fn, static_argnums=(), donate_argnums=()):
+    """jit(shard_map(fn)) over the grid mesh with stacked-layout specs.
+
+    ``fn`` receives each array argument as the device-local block with the
+    two leading (grid) axes of size 1 — use :func:`local` / :func:`relocal`
+    to strip/restore them.
+    """
+    P = jax.sharding.PartitionSpec
+    spec = P(ROW_AXIS, COL_AXIS)
+    sm = jax.shard_map(fn, mesh=grid.mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    return jax.jit(sm, static_argnums=static_argnums, donate_argnums=donate_argnums)
+
+
+def local(x):
+    """Strip the two size-1 leading grid axes of a shard_map-local block."""
+    return x.reshape(x.shape[2:])
+
+
+def relocal(x):
+    """Restore the two size-1 leading grid axes for shard_map output."""
+    return x.reshape((1, 1) + x.shape)
