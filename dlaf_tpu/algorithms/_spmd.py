@@ -1,0 +1,111 @@
+"""Shared SPMD helpers for distributed algorithm kernels.
+
+These run inside ``shard_map`` over the ('r','c') grid mesh and carry the
+static geometry of a stacked block-cyclic matrix (see matrix/layout.py).
+They replace the reference's per-algorithm panel/workspace machinery
+(reference: include/dlaf/matrix/panel.h, common/round_robin.h): panels here
+are just ``[lt, mb, nb]`` tile-stack values flowing through the jitted loop,
+double-buffering/lookahead being XLA's scheduling problem.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.matrix.distribution import Distribution
+
+
+@dataclass(frozen=True)
+class Geometry:
+    """Static per-matrix geometry captured into jitted SPMD kernels."""
+
+    m: int
+    n: int
+    mb: int
+    nb: int
+    mt: int  # global tile rows
+    nt: int  # global tile cols
+    pr: int
+    pc: int
+    ltr: int  # local row slots
+    ltc: int  # local col slots
+
+    @classmethod
+    def of(cls, dist: Distribution) -> "Geometry":
+        if dist.source_rank != (0, 0):
+            raise NotImplementedError("algorithms require source_rank == (0,0) for now")
+        return cls(
+            m=dist.size.rows,
+            n=dist.size.cols,
+            mb=dist.block_size.rows,
+            nb=dist.block_size.cols,
+            mt=dist.nr_tiles.rows,
+            nt=dist.nr_tiles.cols,
+            pr=dist.grid_size.rows,
+            pc=dist.grid_size.cols,
+            ltr=dist.local_slots.rows,
+            ltc=dist.local_slots.cols,
+        )
+
+
+def local_row_tiles(g: Geometry, myr):
+    """Global row-tile index of each local row slot: gi[li] = li*Pr + myr."""
+    return jnp.arange(g.ltr) * g.pr + myr
+
+
+def local_col_tiles(g: Geometry, myc):
+    return jnp.arange(g.ltc) * g.pc + myc
+
+
+def pad_diag_identity(x, g: Geometry, myr, myc, remove: bool = False):
+    """Add (or remove) 1.0 on padding diagonal elements (global element index
+    >= min(m, n) on diagonal tiles) so factorizations of padded edge tiles
+    stay non-singular.  The algorithm-side counterpart of the reference's
+    exact ragged tile sizes (we pad to uniform slots instead)."""
+    gi = local_row_tiles(g, myr)
+    gj = local_col_tiles(g, myc)
+    diag_tile = gi[:, None] == gj[None, :]  # [ltr, ltc]
+    ge = gi[:, None] * g.mb + jnp.arange(g.mb)[None, :]  # [ltr, mb] global row el
+    pad_el = ge >= min(g.m, g.n)  # padding rows
+    sq = jnp.eye(g.mb, g.nb, dtype=x.dtype)
+    mask = (
+        diag_tile[:, :, None, None]
+        * pad_el[:, None, :, None]
+        * sq[None, None, :, :]
+    ).astype(x.dtype)
+    return x - mask if remove else x + mask
+
+
+def take_col(x, lkc, g: Geometry):
+    """Extract local tile column ``lkc`` (traced) -> [ltr, mb, nb]."""
+    return lax.dynamic_slice(x, (0, lkc, 0, 0), (g.ltr, 1, g.mb, g.nb))[:, 0]
+
+
+def put_col(x, col, lkc):
+    return lax.dynamic_update_slice(x, col[:, None], (0, lkc, 0, 0))
+
+
+def take_row(x, lkr, g: Geometry):
+    """Extract local tile row ``lkr`` (traced) -> [ltc, mb, nb]."""
+    return lax.dynamic_slice(x, (lkr, 0, 0, 0), (1, g.ltc, g.mb, g.nb))[0]
+
+
+def put_row(x, row, lkr):
+    return lax.dynamic_update_slice(x, row[None, :], (lkr, 0, 0, 0))
+
+
+def take_tile(col, lk):
+    """Extract tile ``lk`` (traced) from a [lt, mb, nb] panel."""
+    return lax.dynamic_index_in_dim(col, lk, 0, keepdims=False)
+
+
+def bcast_diag_tile(x, k, g: Geometry, myr, myc):
+    """Broadcast global diagonal tile (k, k) to every rank."""
+    kr, kc = k % g.pr, k % g.pc
+    lkr, lkc = k // g.pr, k // g.pc
+    mine = (myr == kr) & (myc == kc)
+    t = take_tile(take_col(x, lkc, g), lkr)
+    return coll.bcast2d(jnp.where(mine, t, jnp.zeros_like(t)), kr, kc)

@@ -1,0 +1,112 @@
+"""Distributed tiled Cholesky factorization.
+
+TPU-native re-design of the reference right-looking tiled POTRF
+(reference: include/dlaf/factorization/cholesky.h:42-84 and
+factorization/cholesky/impl.h:151-453).  The reference builds a task DAG per
+step k: potrf(diag) -> column trsm panel -> col/row panel broadcasts ->
+per-tile herk/gemm trailing update, with lookahead priorities and
+communicator pipelines.  Here the whole factorization is ONE jitted SPMD
+program: a ``lax.fori_loop`` over k where each iteration does
+
+  1. psum-broadcast of the diagonal tile; every rank redundantly computes the
+     nb x nb potrf (cheaper than a second broadcast — replaces the
+     potrfDiagTile task, impl.h:228),
+  2. batched panel trsm of this rank's local column tiles (impl.h:254-262),
+  3. column-panel broadcast along 'c' + transposed row panel via
+     ``transpose_panel`` (replaces broadcast_panel.h col+row broadcasts),
+  4. trailing update as ONE batched einsum over the whole local tile stack
+     (replaces the per-(i,j) herk/gemm task loop, impl.h:273-300); masks keep
+     shapes static — tiles at or left of the pivot get zero contributions.
+
+Lookahead/priorities/round-robin workspaces have no analogue: XLA schedules
+the collectives against the einsum, and steps overlap through JAX async
+dispatch.  Both triangles of the trailing matrix are updated (Hermitian
+storage) — on the MXU the full-tile einsum is faster than triangle
+bookkeeping; on exit only the requested triangle holds the factor, the other
+is garbage exactly as in LAPACK potrf.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.algorithms import _spmd
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+def _chol_L_kernel(x, g: _spmd.Geometry):
+    """shard_map-local kernel: x is [1,1,ltr,ltc,mb,mb]; returns same."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    gi = _spmd.local_row_tiles(g, myr)
+
+    def body(k, x):
+        kr, kc = k % g.pr, k % g.pc
+        lkc = k // g.pc
+        # 1. diagonal tile to everyone; redundant local potrf
+        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        lkk = t.potrf(d, lower=True)
+        # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
+        xc = _spmd.take_col(x, lkc, g)
+        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+        below = (gi > k)[:, None, None]
+        cp_own = jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan))
+        # 3. column panel to all rank columns; transposed row panel
+        cp = coll.psum_axis(cp_own, COL_AXIS)  # [ltr, mb, mb]
+        rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
+        # write back the factored column (pivot tile + sub-diagonal tiles)
+        new_col = jnp.where(
+            myc == kc,
+            jnp.where((gi == k)[:, None, None], lkk[None], jnp.where(below, pan, xc)),
+            xc,
+        )
+        x = _spmd.put_col(x, new_col, lkc)
+        # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
+        x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+        return x
+
+    x = lax.fori_loop(0, g.mt, body, x)
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
+_kernel_cache = {}
+
+
+def _compiled(grid, g: _spmd.Geometry, uplo: str):
+    key = (id(grid.mesh), g, uplo)
+    if key not in _kernel_cache:
+        kern = partial(_chol_L_kernel, g=g)
+        _kernel_cache[key] = coll.spmd(grid, kern, donate_argnums=(0,))
+    return _kernel_cache[key]
+
+
+def cholesky_factorization(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:
+    """Factor the Hermitian positive-definite ``mat_a`` (both triangles
+    stored) in place: on return the ``uplo`` triangle holds the Cholesky
+    factor.  Async: returns immediately, result materializes lazily
+    (reference API: factorization/cholesky.h:72, also graph-building async).
+    """
+    if mat_a.size.rows != mat_a.size.cols:
+        raise ValueError("cholesky: matrix must be square")
+    if mat_a.block_size.rows != mat_a.block_size.cols:
+        raise ValueError("cholesky: tiles must be square")
+    g = _spmd.Geometry.of(mat_a.dist)
+    if g.mt == 0:
+        return mat_a
+    if uplo == t.LOWER:
+        data = _compiled(mat_a.grid, g, uplo)(mat_a.data)
+        return mat_a.like(data)
+    if uplo == t.UPPER:
+        # A = U^H U with U = L^H of the conj-transposed problem: factor the
+        # Hermitian matrix itself (A^H = A), take L from the Lower path on
+        # A^T.conj == A... the Upper factor is computed natively by running
+        # the Lower kernel on the transposed stacked layout.
+        raise NotImplementedError("uplo='U' arrives with the transposed-layout pass")
+    raise ValueError(f"bad uplo {uplo}")

@@ -1,0 +1,115 @@
+"""Tile-level compute kernels.
+
+TPU-native analogue of the reference's tile BLAS/LAPACK wrappers
+(reference: include/dlaf/blas/tile.h, include/dlaf/lapack/tile.h).  Where the
+reference dispatches each tile op to BLASPP/cuBLAS/cuSOLVER as an individual
+pika task, here tile ops are jnp/lax.linalg calls — batched over stacked tile
+arrays (leading axes broadcast) so XLA fuses them and tiles them onto the
+MXU.  There is no Policy/priority/stream machinery: scheduling is XLA's.
+
+Convention: a "tile stack" is an array [..., mb, nb]; ops broadcast over the
+leading axes.  ``herk``-style updates are expressed by callers as one batched
+einsum over the whole local tile stack (see algorithms/) — that is the TPU
+replacement for the reference's per-tile task loop.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# blas::Side / Uplo / Op / Diag analogues (blaspp enums used throughout the
+# reference API surface, e.g. blas/tile.h)
+LOWER = "L"
+UPPER = "U"
+LEFT = "Left"
+RIGHT = "Right"
+NO_TRANS = "N"
+TRANS = "T"
+CONJ_TRANS = "C"
+UNIT = "U"
+NON_UNIT = "N"
+
+
+def potrf(a, lower: bool = True):
+    """Cholesky of a (batch of) Hermitian tile(s) (tile::potrf,
+    lapack/tile.h).  Returns the triangular factor with the other triangle
+    zeroed (jnp.linalg.cholesky semantics)."""
+    if lower:
+        return jnp.linalg.cholesky(a)
+    # U = (cholesky(A^H))^H with A Hermitian: factor via lower of conj
+    return _adj(jnp.linalg.cholesky(_adj(a)))
+
+
+def _adj(a):
+    return jnp.swapaxes(a, -1, -2).conj()
+
+
+def op_tile(a, op: str):
+    """Apply blas::Op to a tile stack."""
+    if op == NO_TRANS:
+        return a
+    if op == TRANS:
+        return jnp.swapaxes(a, -1, -2)
+    if op == CONJ_TRANS:
+        return _adj(a)
+    raise ValueError(f"bad op {op}")
+
+
+def trsm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
+    """B := alpha * op(A)^-1 B (Left) or alpha * B op(A)^-1 (Right), A
+    triangular (tile::trsm, blas/tile.h).  Batched over leading axes."""
+    lower = uplo == LOWER
+    # lax.linalg requires identical batch ranks: broadcast A over B's batch
+    batch = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+    a = jnp.broadcast_to(a, batch + a.shape[-2:])
+    b = jnp.broadcast_to(b, batch + b.shape[-2:])
+    return lax.linalg.triangular_solve(
+        a,
+        alpha * b,
+        left_side=(side == LEFT),
+        lower=lower,
+        transpose_a=(op in (TRANS, CONJ_TRANS)),
+        conjugate_a=(op == CONJ_TRANS),
+        unit_diagonal=(diag == UNIT),
+    )
+
+
+def trmm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
+    """B := alpha * op(A) B (Left) or alpha * B op(A) (Right), A triangular."""
+    tri = jnp.tril(a) if uplo == LOWER else jnp.triu(a)
+    if diag == UNIT:
+        eye = jnp.eye(tri.shape[-1], dtype=tri.dtype)
+        tri = tri - tri * eye + eye  # replace diagonal with ones
+    tri = op_tile(tri, op)
+    return alpha * (tri @ b if side == LEFT else b @ tri)
+
+
+def gemm(opa: str, opb: str, alpha, a, b, beta, c):
+    """C := alpha op(A) op(B) + beta C (tile::gemm)."""
+    return alpha * (op_tile(a, opa) @ op_tile(b, opb)) + beta * c
+
+
+def herk(uplo: str, op: str, alpha, a, beta, c):
+    """C := alpha op(A) op(A)^H + beta C, C Hermitian (tile::herk).
+
+    Computes the full tile (both triangles) — callers rely on Hermitian
+    storage rather than triangle-only updates (TPU-friendlier than the
+    reference's triangle-only semantics)."""
+    oa = op_tile(a, op)
+    return alpha * (oa @ _adj(oa)) + beta * c
+
+
+def hemm(side: str, uplo: str, alpha, a, b, beta, c):
+    """C := alpha A B + beta C with A Hermitian (full-storage assumed)."""
+    return alpha * (a @ b if side == LEFT else b @ a) + beta * c
+
+
+def lange_max(a):
+    """max-norm of a tile stack (tile::lange(max), lapack/tile.h)."""
+    return jnp.max(jnp.abs(a)) if a.size else jnp.zeros((), jnp.result_type(a).type(0).real.dtype)
+
+
+def laset(shape, alpha, beta, dtype):
+    """Tile filled with alpha off-diagonal, beta on diagonal (tile::laset)."""
+    eye = jnp.eye(shape[-2], shape[-1], dtype=dtype)
+    return jnp.full(shape, alpha, dtype) * (1 - eye) + beta * eye

@@ -1,0 +1,73 @@
+"""Test/verification utilities.
+
+Analogue of the reference test harness helpers
+(reference: test/include/dlaf_test/matrix/util_matrix.h — set/CHECK_MATRIX_NEAR,
+test/include/dlaf_test/util_types.h — element types): matrix generators with
+known structure plus elementwise comparison with an N-scaled error budget
+(test_cholesky.cpp:76-78 scales tolerances with matrix size)."""
+from __future__ import annotations
+
+import numpy as np
+
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+# dtype sweep mirroring MatrixElementTypes {float, double, complex<float>,
+# complex<double>}
+ELEMENT_TYPES = [np.float32, np.float64, np.complex64, np.complex128]
+REAL_TYPES = [np.float32, np.float64]
+
+
+def random_hermitian_pd(n: int, dtype, seed: int = 0) -> np.ndarray:
+    """Random Hermitian positive-definite matrix with condition O(n)."""
+    rng = np.random.default_rng(seed)
+    dt = np.dtype(dtype)
+    if dt.kind == "c":
+        b = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    else:
+        b = rng.standard_normal((n, n))
+    a = (b @ b.conj().T) / n + np.eye(n)
+    return a.astype(dt)
+
+
+def random_matrix(m: int, n: int, dtype, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    dt = np.dtype(dtype)
+    if dt.kind == "c":
+        a = rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+    else:
+        a = rng.standard_normal((m, n))
+    return a.astype(dt)
+
+
+def random_triangular(n: int, dtype, lower: bool = True, unit: bool = False, seed: int = 0):
+    """Well-conditioned random triangular matrix."""
+    a = random_matrix(n, n, dtype, seed)
+    a = np.tril(a) if lower else np.triu(a)
+    d = np.abs(np.diagonal(a)) + n  # diagonal dominance for conditioning
+    np.fill_diagonal(a, 1.0 if unit else d)
+    return a.astype(np.dtype(dtype))
+
+
+def tol_for(dtype, n: int, factor: float = 10.0) -> float:
+    """Error budget scaled with N, as in the reference checks."""
+    eps = np.finfo(np.dtype(dtype)).eps
+    return factor * max(n, 1) * float(eps)
+
+
+def assert_near(mat: DistributedMatrix, expected: np.ndarray, tol: float, uplo: str | None = None):
+    """Elementwise comparison of a distributed matrix against a host oracle
+    (CHECK_MATRIX_NEAR, util_matrix.h:281).  ``uplo`` restricts the compared
+    triangle ('L'/'U')."""
+    got = mat.to_global()
+    assert got.shape == expected.shape, (got.shape, expected.shape)
+    if uplo == "L":
+        sel = np.tril_indices(expected.shape[0], 0, expected.shape[1])
+        got, expected = got[sel], expected[sel]
+    elif uplo == "U":
+        sel = np.triu_indices(expected.shape[0], 0, expected.shape[1])
+        got, expected = got[sel], expected[sel]
+    if not got.size:
+        return
+    scale = max(np.max(np.abs(expected)), 1.0)
+    err = np.max(np.abs(got - expected)) / scale
+    assert err <= tol, f"max rel-ish error {err:.3e} > tol {tol:.3e}"
