@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Headline benchmark: distributed Cholesky (POTRF) GFlop/s on the local chip.
+
+Matches BASELINE.json config "miniapp_cholesky FP64, N=4096, nb=256,
+single-rank local".  ``vs_baseline`` is measured against a nominal 100
+GFlop/s — a representative single-rank CPU-node figure for the reference's
+MC backend at this size (the reference publishes no absolute numbers in-repo;
+see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+N = 4096
+NB = 256
+NRUNS = 3
+BASELINE_GFLOPS = 100.0
+
+
+def main():
+    jax.config.update("jax_enable_x64", True)
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index import Size2D
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+    from dlaf_tpu.miniapp.common import sync
+
+    grid = Grid.create(Size2D(1, 1))
+    a = tu.random_hermitian_pd(N, np.float64, seed=1)
+    flops = 2 * N**3 / 6  # potrf: n^3/6 adds + n^3/6 muls (reference types.h:160)
+
+    best = None
+    for i in range(NRUNS + 1):
+        mat = DistributedMatrix.from_global(grid, a, (NB, NB))
+        sync(mat.data)
+        t0 = time.perf_counter()
+        out = cholesky_factorization("L", mat)
+        sync(out.data)
+        dt = time.perf_counter() - t0
+        if i == 0:
+            continue  # warmup/compile
+        best = dt if best is None else min(best, dt)
+    gflops = flops / best / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "potrf_gflops_n4096_f64_1chip",
+                "value": round(gflops, 3),
+                "unit": "GFlop/s",
+                "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Shared miniapp infrastructure.
+
+Analogue of the reference miniapp harness
+(reference: miniapp/include/dlaf/miniapp/options.h:201 MiniappOptions,
+miniapp/miniapp_cholesky.cpp:106-195): parse options, build the grid, run the
+algorithm ``nruns`` times, print per-run ``[i] time GFlop/s`` lines, optional
+correctness check on the last run.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import numpy as np
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index import Size2D
+
+DTYPES = {
+    "s": np.float32,
+    "d": np.float64,
+    "c": np.complex64,
+    "z": np.complex128,
+}
+
+
+def ops_add_mul(dtype, add: float, mul: float) -> float:
+    """reference types.h:160 total_ops: complex mul = 6 flops, add = 2."""
+    if np.dtype(dtype).kind == "c":
+        return 2.0 * add + 6.0 * mul
+    return add + mul
+
+
+def sync(arr) -> None:
+    """Force completion of all pending computation on ``arr``.
+
+    ``jax.block_until_ready`` can return early on tunneled/experimental
+    platforms (axon); fetching one element is a true execution barrier
+    without transferring the buffer."""
+    jax.block_until_ready(arr)
+    if arr.size:
+        jax.device_get(arr[(0,) * arr.ndim])
+
+
+def miniapp_parser(desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=desc)
+    p.add_argument("--matrix-size", "--m", type=int, default=4096, dest="m")
+    p.add_argument("--block-size", "--mb", type=int, default=256, dest="mb")
+    p.add_argument("--grid-rows", type=int, default=1)
+    p.add_argument("--grid-cols", type=int, default=1)
+    p.add_argument("--nruns", type=int, default=3)
+    p.add_argument("--nwarmups", type=int, default=1)
+    p.add_argument("--type", choices="sdcz", default="d")
+    p.add_argument("--check", choices=["none", "last", "all"], default="none")
+    return p
+
+
+def make_grid(args) -> Grid:
+    if np.dtype(DTYPES[args.type]).itemsize == 8:
+        jax.config.update("jax_enable_x64", True)
+    return Grid.create(Size2D(args.grid_rows, args.grid_cols))
+
+
+def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
+    """Warmup + timed runs with per-run report lines."""
+    results = []
+    for i in range(-args.nwarmups, args.nruns):
+        mat = make_input()
+        sync(mat.data)
+        t0 = time.perf_counter()
+        out = run(mat)
+        sync(out.data)
+        dt = time.perf_counter() - t0
+        if i < 0:
+            continue
+        gflops = (flops_fn(args) / dt / 1e9) if flops_fn else float("nan")
+        print(f"[{i}] {name} {dt:.6f}s {gflops:.3f}GFlop/s"
+              f" ({args.m}, {args.m}) ({args.mb}, {args.mb}) ({args.grid_rows}, {args.grid_cols})")
+        results.append((dt, gflops))
+        if check and (args.check == "all" or (args.check == "last" and i == args.nruns - 1)):
+            check(out)
+            print(f"[{i}] check passed")
+    return results

@@ -1,0 +1,41 @@
+"""Cholesky benchmark driver (reference: miniapp/miniapp_cholesky.cpp).
+
+Usage: python -m dlaf_tpu.miniapp.miniapp_cholesky --m 4096 --mb 256 \
+          --grid-rows 1 --grid-cols 1 --nruns 3 --check last
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.miniapp import common
+
+
+def flops(args):
+    add_mul = args.m**3 / 6
+    return common.ops_add_mul(common.DTYPES[args.type], add_mul, add_mul)
+
+
+def main(argv=None):
+    args = common.miniapp_parser(__doc__).parse_args(argv)
+    grid = common.make_grid(args)
+    dtype = common.DTYPES[args.type]
+    a = tu.random_hermitian_pd(args.m, dtype, seed=1)
+
+    def make_input():
+        return DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
+
+    def run(mat):
+        return cholesky_factorization("L", mat)
+
+    def check(out):
+        expected = np.linalg.cholesky(a)
+        tu.assert_near(out, expected, tu.tol_for(dtype, args.m, 100.0), uplo="L")
+
+    return common.run_timed(args, make_input, run, check, flops, name="cholesky")
+
+
+if __name__ == "__main__":
+    main()
