@@ -103,6 +103,27 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     return lax.psum(contrib, ROW_AXIS)
 
 
+def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
+    """Row panel -> column panel redistribution (inverse of
+    :func:`transpose_panel`).
+
+    ``rp[ltc, ...]`` holds (after a row-axis broadcast) panel tiles indexed by
+    this rank-column's global col-tiles ``j = lj*Pc + myc``.  Returns
+    ``cp[ltr, ...]`` with ``cp[li] = panel tile of global index
+    i = li*Pr + myr`` (zero where ``i >= nr_col_tiles``).  Cost: one psum over
+    the col axis."""
+    myr, myc = my_rank()
+    pr, pc = grid_shape()
+    ltc = rp.shape[0]
+    iv = jnp.arange(ltr) * pr + myr
+    src_slot = jnp.clip(iv // pc, 0, ltc - 1)
+    have = (iv % pc == myc) & (iv < nr_col_tiles)
+    contrib = jnp.where(
+        have.reshape((ltr,) + (1,) * (rp.ndim - 1)), jnp.take(rp, src_slot, axis=0), 0
+    )
+    return lax.psum(contrib, COL_AXIS)
+
+
 def spmd(grid, fn, static_argnums=(), donate_argnums=()):
     """jit(shard_map(fn)) over the grid mesh with stacked-layout specs.
 

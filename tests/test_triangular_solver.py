@@ -1,0 +1,68 @@
+"""Distributed TRSM tests — all 16 side/uplo/op/diag combos
+(reference: test/unit/solver/test_triangular.cpp)."""
+import itertools
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+COMBOS = list(itertools.product("LR", "LU", "NTC", "NU"))
+
+
+def oracle(side, uplo, op, diag, alpha, a, b):
+    opa = {"N": a, "T": a.T, "C": a.conj().T}[op]
+    tri = np.tril(opa) if (uplo == "L") != (op != "N") else np.triu(opa)
+    if diag == "U":
+        np.fill_diagonal(tri, 1.0)
+    if side == "L":
+        return np.linalg.solve(tri, alpha * b)
+    return np.linalg.solve(tri.T, alpha * b.T).T
+
+
+@pytest.mark.parametrize("side,uplo,op,diag", COMBOS)
+def test_trsm_combos(grid_2x4, side, uplo, op, diag):
+    dtype = np.complex128 if op == "C" else np.float64
+    m, n, mb = 13, 9, 4
+    an = m if side == "L" else n
+    a = tu.random_triangular(an, dtype, lower=(uplo == "L"), seed=3)
+    # store garbage in the other triangle to ensure it is not read
+    a = a + (np.triu(np.ones((an, an)), 1) if uplo == "L" else np.tril(np.ones((an, an)), -1)) * 7.7
+    b = tu.random_matrix(m, n, dtype, seed=5)
+    alpha = 1.5
+    expected = oracle(side, uplo, op, diag, alpha, a, b)
+    mat_a = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    out = triangular_solver(
+        {"L": t.LEFT, "R": t.RIGHT}[side], uplo, op, diag, alpha, mat_a, mat_b
+    )
+    tu.assert_near(out, expected, tu.tol_for(dtype, an, 200.0))
+
+
+@pytest.mark.parametrize("dtype", tu.ELEMENT_TYPES, ids=str)
+def test_trsm_dtypes_all_grids(comm_grids, dtype):
+    m, n, mb = 16, 12, 4
+    a = tu.random_triangular(m, dtype, lower=True, seed=1)
+    b = tu.random_matrix(m, n, dtype, seed=2)
+    expected = sla.solve_triangular(a, b, lower=True)
+    tol = tu.tol_for(dtype, m, 200.0)
+    for grid in comm_grids:
+        mat_a = DistributedMatrix.from_global(grid, a, (mb, mb))
+        mat_b = DistributedMatrix.from_global(grid, b, (mb, mb))
+        out = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
+        tu.assert_near(out, expected, tol)
+
+
+def test_trsm_ragged_sizes(grid_2x4):
+    for (m, n, mb) in [(3, 5, 4), (8, 8, 3), (21, 7, 5), (1, 1, 4)]:
+        a = tu.random_triangular(m, np.float64, lower=True, seed=m)
+        b = tu.random_matrix(m, n, np.float64, seed=n)
+        expected = sla.solve_triangular(a, b, lower=True)
+        mat_a = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+        mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+        out = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
+        tu.assert_near(out, expected, tu.tol_for(np.float64, m, 200.0))
