@@ -1,0 +1,316 @@
+"""Distributed matrix multiplication family: GEMM (general), TRMM
+(triangular), HEMM (Hermitian) on the 2D block-cyclic grid.
+
+TPU-native re-design of the reference multiplication algorithms
+(reference: include/dlaf/multiplication/{general,triangular,hermitian}.h and
+their impl.h files).  All three share ONE SUMMA-style SPMD kernel: a jitted
+fori_loop over the contraction tile index k where each step
+
+  1. broadcasts column k of op(A)-tiles along 'c' (owner rank-column) and
+     row k of op(B)-tiles along 'r' (owner rank-row) — for transposed
+     operands the panel is fetched from the transposed storage direction and
+     re-distributed with the transpose_panel collectives,
+  2. accumulates C += panel_outer_product as one batched einsum.
+
+Triangular/Hermitian structure is applied by masking the broadcast A panels
+(tril/triu of diagonal tiles, zero/mirrored off-triangle tiles) instead of
+the reference's per-case tile loops (multiplication/triangular/impl.h: 726
+lines over 16 combos).  The reference computes TRMM in place; we return a
+fresh C (functional), letting XLA alias buffers where legal.
+
+This replaces, in one file: `triangular_multiplication`
+(multiplication/triangular.h:48), `hermitian_multiplication`
+(multiplication/hermitian.h:29), and internal `GeneralSub::callNN`
+(multiplication/general/api.h:28).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.algorithms import _spmd
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+# A-panel structure masks
+_FULL = "full"
+_LOWER_TRI = "ltri"  # A triangular-lower: tiles above diag zero, diag tril
+_UPPER_TRI = "utri"
+_HERM_LOWER = "herm_l"  # Hermitian, lower stored: upper tiles = mirror^H
+_HERM_UPPER = "herm_u"
+
+
+def _a_col_panel(a, k, g_a, myr, myc, op, structure, diag, ltr_out, mt_out):
+    """Tiles op(A)[i, k] for this rank's local rows i, broadcast to all rank
+    columns.  [ltr_out, mb, mb]."""
+    gi = jnp.arange(ltr_out) * g_a.pr + myr
+
+    def direct_col():
+        # column k of A, masked by structure
+        kc = k % g_a.pc
+        ac = _spmd.take_col(a, k // g_a.pc, g_a)
+        ac = _structure_mask_col(ac, gi, k, structure, diag)
+        return coll.psum_axis(
+            jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS
+        )
+
+    def from_row():
+        # row k of A (tiles A[k, j]), op-transposed into a column panel
+        kr = k % g_a.pr
+        ar = _spmd.take_row(a, k // g_a.pr, g_a)
+        gj = jnp.arange(g_a.ltc) * g_a.pc + myc
+        ar = _structure_mask_col(
+            jnp.swapaxes(ar, -1, -2), gj, k, _transpose_structure(structure), diag
+        )
+        ar = jnp.swapaxes(ar, -1, -2)
+        rp = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        cp = coll.transpose_panel_rows(rp, mt_out, ltr_out)
+        return t.op_tile(cp, op)
+
+    if structure in (_HERM_LOWER, _HERM_UPPER):
+        # Hermitian: column k assembled from BOTH the stored triangle's column
+        # and the conj-transposed stored row (diagonal-crossing mirror).
+        lower = structure == _HERM_LOWER
+        kc, kr = k % g_a.pc, k % g_a.pr
+        ac = _spmd.take_col(a, k // g_a.pc, g_a)
+        keep_col = (gi >= k) if lower else (gi <= k)
+        ac = jnp.where(keep_col[:, None, None], ac, jnp.zeros_like(ac))
+        # make the diagonal tile exactly Hermitian from its stored triangle
+        dmask = (gi == k)[:, None, None]
+        ac = jnp.where(dmask, _hermitize_tile(ac, lower), ac)
+        cp1 = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+        ar = _spmd.take_row(a, k // g_a.pr, g_a)
+        gj = jnp.arange(g_a.ltc) * g_a.pc + myc
+        keep_row = (gj < k) if lower else (gj > k)  # strict mirror: diag from col
+        ar = jnp.where(keep_row[:, None, None], ar, jnp.zeros_like(ar))
+        rp = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        cp2 = t.op_tile(coll.transpose_panel_rows(rp, mt_out, ltr_out), t.CONJ_TRANS)
+        return cp1 + cp2
+    if op == t.NO_TRANS:
+        return direct_col()
+    return from_row()
+
+
+def _transpose_structure(structure):
+    return {_FULL: _FULL, _LOWER_TRI: _UPPER_TRI, _UPPER_TRI: _LOWER_TRI}[structure]
+
+
+def _hermitize_tile(tiles, lower: bool):
+    """Build the full Hermitian tile from its stored triangle."""
+    if lower:
+        tri = jnp.tril(tiles)
+        return tri + jnp.swapaxes(jnp.tril(tiles, -1), -1, -2).conj()
+    tri = jnp.triu(tiles)
+    return tri + jnp.swapaxes(jnp.triu(tiles, 1), -1, -2).conj()
+
+
+def _structure_mask_col(ac, gi, k, structure, diag):
+    """Mask a column-k panel [lt, mb, nb] of A by triangular structure."""
+    if structure == _FULL:
+        return ac
+    lower = structure == _LOWER_TRI
+    keep = (gi >= k) if lower else (gi <= k)
+    ac = jnp.where(keep[:, None, None], ac, jnp.zeros_like(ac))
+    dmask = (gi == k)[:, None, None]
+    dtile = jnp.tril(ac) if lower else jnp.triu(ac)
+    if diag == t.UNIT:
+        eye = jnp.eye(ac.shape[-2], ac.shape[-1], dtype=ac.dtype)
+        dtile = dtile - dtile * eye + eye
+    return jnp.where(dmask, dtile, ac)
+
+
+def _b_row_panel(b, k, g_b, myr, myc, op, ltc_out, nt_out):
+    """Tiles op(B)[k, j] for this rank's local cols j, broadcast to all rank
+    rows.  [ltc_out, mb, nb]."""
+    if op == t.NO_TRANS:
+        kr = k % g_b.pr
+        br = _spmd.take_row(b, k // g_b.pr, g_b)
+        return coll.psum_axis(jnp.where(myr == kr, br, jnp.zeros_like(br)), ROW_AXIS)
+    kc = k % g_b.pc
+    bc = _spmd.take_col(b, k // g_b.pc, g_b)
+    cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
+    rp = coll.transpose_panel(cp, nt_out, ltc_out)
+    return t.op_tile(rp, op)
+
+
+def _summa_kernel(
+    a, b, c, g_a, g_b, g_c, opa, opb, alpha, beta, structure, diag, kt
+):
+    a, b, c = coll.local(a), coll.local(b), coll.local(c)
+    myr, myc = coll.my_rank()
+    c = (jnp.asarray(beta, c.dtype) * c).astype(c.dtype)
+    al = jnp.asarray(alpha, c.dtype)
+
+    def body(k, c):
+        cp = _a_col_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltr, g_c.mt)
+        rp = _b_row_panel(b, k, g_b, myr, myc, opb, g_c.ltc, g_c.nt)
+        return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+
+    c = lax.fori_loop(0, kt, body, c)
+    return coll.relocal(c)
+
+
+_cache = {}
+
+
+def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
+    g_a = _spmd.Geometry.of(mat_a.dist)
+    g_b = _spmd.Geometry.of(mat_b.dist)
+    g_c = _spmd.Geometry.of(mat_c.dist)
+    if g_c.mt == 0 or g_c.nt == 0:
+        return mat_c
+    key = (
+        id(mat_c.grid.mesh), opa, opb, complex(alpha), complex(beta), structure,
+        diag, kt, g_a, g_b, g_c,
+    )
+    if key not in _cache:
+        kern = partial(
+            _summa_kernel, g_a=g_a, g_b=g_b, g_c=g_c, opa=opa, opb=opb,
+            alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
+        )
+        _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+    return mat_c.like(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+
+
+def general_multiplication(
+    opa: str, opb: str, alpha, mat_a, mat_b, beta, mat_c
+) -> DistributedMatrix:
+    """C := alpha op(A) op(B) + beta C (reference GeneralSub::callNN extended
+    to transposed operands)."""
+    g_a = _spmd.Geometry.of(mat_a.dist)
+    kt = g_a.nt if opa == t.NO_TRANS else g_a.mt
+    _check_mult_shapes(opa, opb, mat_a, mat_b, mat_c)
+    return _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, _FULL, t.NON_UNIT, kt)
+
+
+def triangular_multiplication(
+    side: str, uplo: str, op: str, diag: str, alpha, mat_a, mat_b
+) -> DistributedMatrix:
+    """B := alpha op(A) B (Left) or alpha B op(A) (Right), A triangular
+    (reference multiplication/triangular.h:48).  Returns new B."""
+    structure = _LOWER_TRI if uplo == t.LOWER else _UPPER_TRI
+    out = DistributedMatrix(
+        mat_b.dist, mat_b.grid, jnp.zeros_like(mat_b.data)
+    )
+    if side == t.LEFT:
+        g_a = _spmd.Geometry.of(mat_a.dist)
+        kt = g_a.nt
+        return _run_summa(mat_a, mat_b, out, op, t.NO_TRANS, alpha, 0.0, structure, diag, kt)
+    # Right: B op(A) — swap roles via (B op(A)) = (op(A)^T B^T)^T; instead use
+    # the same SUMMA with A as the B-side row panel: C = alpha B op(A)
+    return _run_summa_right(mat_a, mat_b, out, op, alpha, structure, diag)
+
+
+def hermitian_multiplication(
+    side: str, uplo: str, alpha, mat_a, mat_b, beta, mat_c
+) -> DistributedMatrix:
+    """C := alpha A B + beta C with A Hermitian, only ``uplo`` triangle stored
+    (reference multiplication/hermitian.h:29; side=R mapped via the
+    conj/transpose trick there — here both sides are native)."""
+    structure = _HERM_LOWER if uplo == t.LOWER else _HERM_UPPER
+    if side == t.LEFT:
+        g_a = _spmd.Geometry.of(mat_a.dist)
+        return _run_summa(
+            mat_a, mat_b, mat_c, t.NO_TRANS, t.NO_TRANS, alpha, beta, structure, t.NON_UNIT, g_a.nt
+        )
+    return _run_summa_right(mat_a, mat_b, mat_c, t.NO_TRANS, alpha, structure, t.NON_UNIT, beta=beta)
+
+
+def _summa_right_kernel(a, b, c, g_a, g_b, g_c, opa, alpha, beta, structure, diag, kt):
+    """C := alpha B op(A) + beta C — contraction over B cols / op(A) rows.
+    Panels: column k of op(B)... i.e. row panel comes from op(A) rows, col
+    panel from B columns."""
+    a, b, c = coll.local(a), coll.local(b), coll.local(c)
+    myr, myc = coll.my_rank()
+    c = (jnp.asarray(beta, c.dtype) * c).astype(c.dtype)
+    al = jnp.asarray(alpha, c.dtype)
+
+    def body(k, c):
+        # col panel: B[:, k] broadcast along 'c'
+        kc = k % g_b.pc
+        bc = _spmd.take_col(b, k // g_b.pc, g_b)
+        cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
+        # row panel: op(A)[k, :] — use the col-panel machinery on the
+        # transposed problem: op(A)[k, j] = opT(op(A)^T[j, k])
+        rp = _a_row_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltc, g_c.nt)
+        return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+
+    c = lax.fori_loop(0, kt, body, c)
+    return coll.relocal(c)
+
+
+def _a_row_panel(a, k, g_a, myr, myc, op, structure, diag, ltc_out, nt_out):
+    """Tiles op(A)[k, j] for this rank's local cols j, broadcast to all rank
+    rows.  Mirror of _a_col_panel."""
+    gj = jnp.arange(ltc_out) * g_a.pc + myc
+    if structure in (_HERM_LOWER, _HERM_UPPER):
+        lower = structure == _HERM_LOWER
+        kr, kc = k % g_a.pr, k % g_a.pc
+        ar = _spmd.take_row(a, k // g_a.pr, g_a)
+        keep_row = (gj <= k) if lower else (gj >= k)
+        ar = jnp.where(keep_row[:, None, None], ar, jnp.zeros_like(ar))
+        dmask = (gj == k)[:, None, None]
+        ar = jnp.where(dmask, _hermitize_tile(ar, lower), ar)
+        rp1 = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        ac = _spmd.take_col(a, k // g_a.pc, g_a)
+        gi = jnp.arange(g_a.ltr) * g_a.pr + myr
+        keep_col = (gi > k) if lower else (gi < k)
+        ac = jnp.where(keep_col[:, None, None], ac, jnp.zeros_like(ac))
+        cp = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+        rp2 = t.op_tile(coll.transpose_panel(cp, nt_out, ltc_out), t.CONJ_TRANS)
+        return rp1 + rp2
+    if op == t.NO_TRANS:
+        kr = k % g_a.pr
+        ar = _spmd.take_row(a, k // g_a.pr, g_a)
+        ar = jnp.swapaxes(
+            _structure_mask_col(
+                jnp.swapaxes(ar, -1, -2), gj, k, _transpose_structure(structure), diag
+            ),
+            -1,
+            -2,
+        )
+        return coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+    # transposed: op(A)[k, j] = op(A[j, k]): fetch A column k, redistribute
+    kc = k % g_a.pc
+    ac = _spmd.take_col(a, k // g_a.pc, g_a)
+    gi = jnp.arange(g_a.ltr) * g_a.pr + myr
+    ac = _structure_mask_col(ac, gi, k, structure, diag)
+    cp = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+    return t.op_tile(coll.transpose_panel(cp, nt_out, ltc_out), op)
+
+
+def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0):
+    g_a = _spmd.Geometry.of(mat_a.dist)
+    g_b = _spmd.Geometry.of(mat_b.dist)
+    g_c = _spmd.Geometry.of(mat_c.dist)
+    if g_c.mt == 0 or g_c.nt == 0:
+        return mat_c
+    kt = g_b.nt
+    key = (
+        "right", id(mat_c.grid.mesh), opa, complex(alpha), complex(beta),
+        structure, diag, kt, g_a, g_b, g_c,
+    )
+    if key not in _cache:
+        kern = partial(
+            _summa_right_kernel, g_a=g_a, g_b=g_b, g_c=g_c, opa=opa,
+            alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
+        )
+        _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+    return mat_c.like(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+
+
+def _check_mult_shapes(opa, opb, mat_a, mat_b, mat_c):
+    am, an = mat_a.size
+    if opa != t.NO_TRANS:
+        am, an = an, am
+    bm, bn = mat_b.size
+    if opb != t.NO_TRANS:
+        bm, bn = bn, bm
+    if (am, bn) != tuple(mat_c.size) or an != bm:
+        raise ValueError(
+            f"gemm: op(A) {am}x{an} op(B) {bm}x{bn} C {tuple(mat_c.size)}"
+        )

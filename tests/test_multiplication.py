@@ -1,0 +1,91 @@
+"""Distributed GEMM/TRMM/HEMM tests
+(reference: test/unit/multiplication/test_{general,triangular,hermitian}.cpp)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.multiplication import (
+    general_multiplication,
+    hermitian_multiplication,
+    triangular_multiplication,
+)
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+SIDES = {"L": t.LEFT, "R": t.RIGHT}
+
+
+def _op(a, op):
+    return {"N": a, "T": a.T, "C": a.conj().T}[op]
+
+
+@pytest.mark.parametrize("opa,opb", itertools.product("NTC", "NTC"))
+def test_gemm_ops(grid_2x4, opa, opb):
+    dtype = np.complex128
+    m, n, k, mb = 10, 7, 13, 4
+    a = tu.random_matrix(*( (m, k) if opa == "N" else (k, m) ), dtype, seed=1)
+    b = tu.random_matrix(*( (k, n) if opb == "N" else (n, k) ), dtype, seed=2)
+    c = tu.random_matrix(m, n, dtype, seed=3)
+    alpha, beta = 1.5 - 0.5j, 0.75 + 0.25j
+    expected = alpha * (_op(a, opa) @ _op(b, opb)) + beta * c
+    ma = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+    mb_ = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    mc = DistributedMatrix.from_global(grid_2x4, c, (mb, mb))
+    out = general_multiplication(opa, opb, alpha, ma, mb_, beta, mc)
+    tu.assert_near(out, expected, tu.tol_for(dtype, k, 50.0))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex64], ids=str)
+def test_gemm_grids(comm_grids, dtype):
+    m, n, k, mb = 12, 9, 6, 4
+    a = tu.random_matrix(m, k, dtype, seed=1)
+    b = tu.random_matrix(k, n, dtype, seed=2)
+    c = np.zeros((m, n), dtype)
+    expected = a @ b
+    for grid in comm_grids:
+        ma = DistributedMatrix.from_global(grid, a, (mb, mb))
+        mb_ = DistributedMatrix.from_global(grid, b, (mb, mb))
+        mc = DistributedMatrix.from_global(grid, c, (mb, mb))
+        out = general_multiplication("N", "N", 1.0, ma, mb_, 0.0, mc)
+        tu.assert_near(out, expected, tu.tol_for(dtype, k, 50.0))
+
+
+@pytest.mark.parametrize("side,uplo,op,diag", itertools.product("LR", "LU", "NTC", "NU"))
+def test_trmm_combos(grid_2x4, side, uplo, op, diag):
+    dtype = np.complex128 if op == "C" else np.float64
+    m, n, mb = 11, 6, 4
+    an = m if side == "L" else n
+    a = tu.random_matrix(an, an, dtype, seed=4)  # full random; only uplo read
+    b = tu.random_matrix(m, n, dtype, seed=5)
+    alpha = 0.5
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(tri, 1.0)
+    opa = _op(tri, op)
+    expected = alpha * (opa @ b) if side == "L" else alpha * (b @ opa)
+    ma = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+    mb_ = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    out = triangular_multiplication(SIDES[side], uplo, op, diag, alpha, ma, mb_)
+    tu.assert_near(out, expected, tu.tol_for(dtype, an, 50.0))
+
+
+@pytest.mark.parametrize("side,uplo", itertools.product("LR", "LU"))
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_hemm(grid_2x4, side, uplo, dtype):
+    m, n, mb = 10, 7, 4
+    an = m if side == "L" else n
+    h = tu.random_hermitian_pd(an, dtype, seed=6)
+    # store only one triangle; poison the other to catch illegal reads
+    a = np.tril(h) if uplo == "L" else np.triu(h)
+    a = a + (np.triu(np.ones_like(h), 1) if uplo == "L" else np.tril(np.ones_like(h), -1)) * 3.3
+    b = tu.random_matrix(m, n, dtype, seed=7)
+    c = tu.random_matrix(m, n, dtype, seed=8)
+    alpha, beta = 1.25, -0.5
+    expected = alpha * (h @ b) + beta * c if side == "L" else alpha * (b @ h) + beta * c
+    ma = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+    mb_ = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    mc = DistributedMatrix.from_global(grid_2x4, c, (mb, mb))
+    out = hermitian_multiplication(SIDES[side], uplo, alpha, ma, mb_, beta, mc)
+    tu.assert_near(out, expected, tu.tol_for(dtype, an, 50.0))
