@@ -1,0 +1,146 @@
+"""Distributed matrix inversion: triangular inverse (TRTRI) and inverse from
+Cholesky factor (POTRI).
+
+TPU-native re-design of the reference inverse algorithms
+(reference: include/dlaf/inverse/triangular.h:38-64 + inverse/triangular/
+impl.h, and inverse/cholesky.h:38-67 + inverse/cholesky/impl.h).
+
+Triangular inverse, lower: backward loop over tile columns k,
+
+    inv[k,k]    = L[k,k]^-1
+    inv[k+1:,k] = -inv[k+1:,k+1:] @ L[k+1:,k] @ inv[k,k]
+
+where the trailing block inverse is already final (backward order).  Each
+step: broadcast original column k, transpose-redistribute it, one batched
+einsum against the local trailing-inverse tiles, psum over the row of grid
+columns, scale by the inverted diagonal tile, masked write-back.  Upper is
+the row-wise mirror.
+
+POTRI: A^-1 = L^-H L^-1 computed as trtri followed by a triangular
+multiplication of the inverse against its own conjugate transpose (the
+reference's lauum-style product, inverse/cholesky/impl.h).  Full Hermitian
+storage is returned.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.algorithms import _spmd
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.matrix import util as mutil
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+def _trtri_lower_kernel(x, g: _spmd.Geometry, diag):
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    gi = _spmd.local_row_tiles(g, myr)
+    gj = _spmd.local_col_tiles(g, myc)
+    eye = jnp.eye(g.mb, dtype=x.dtype)
+
+    def body(s, x):
+        k = g.mt - 1 - s
+        kr, kc = k % g.pr, k % g.pc
+        lkc = k // g.pc
+        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        tkk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, diag, 1.0, akk, eye)
+        # original column k below diagonal, to every rank column
+        xc = _spmd.take_col(x, lkc, g)
+        below = (gi > k)[:, None, None]
+        cp = coll.psum_axis(
+            jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
+        )
+        rp = coll.transpose_panel(cp, g.mt, g.ltc)  # L[j,k] at local cols j>k
+        # S[i] = sum_j inv[i,j] L[j,k] over trailing cols (inv cols > k final);
+        # tiles above the diagonal are never referenced (may hold garbage)
+        keep_cols = ((gj > k)[None, :] & (gi[:, None] >= gj[None, :]))[:, :, None, None]
+        s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep_cols, x, jnp.zeros_like(x)), rp)
+        s_full = coll.psum_axis(s_part, COL_AXIS)
+        newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
+        newcol = jnp.where(
+            (gi == k)[:, None, None], tkk[None], jnp.where(below, newcol, xc)
+        )
+        return _spmd.put_col(x, jnp.where(myc == kc, newcol, xc), lkc)
+
+    x = lax.fori_loop(0, g.mt, body, x)
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
+def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    gi = _spmd.local_row_tiles(g, myr)
+    gj = _spmd.local_col_tiles(g, myc)
+    eye = jnp.eye(g.mb, dtype=x.dtype)
+
+    def body(s, x):
+        k = g.mt - 1 - s
+        kr, kc = k % g.pr, k % g.pc
+        lkr = k // g.pr
+        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        tkk = t.trsm(t.LEFT, t.UPPER, t.NO_TRANS, diag, 1.0, akk, eye)
+        # original row k right of diagonal, to every rank row
+        xr = _spmd.take_row(x, lkr, g)
+        right = (gj > k)[:, None, None]
+        rp = coll.psum_axis(
+            jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
+        )
+        cp = coll.transpose_panel_rows(rp, g.nt, g.ltr)  # U[k,i] at local rows i>k
+        # S[j] = sum_i U[k,i] inv[i,j] over trailing rows (inv rows > k final);
+        # tiles below the diagonal are never referenced (may hold garbage)
+        keep_rows = ((gi > k)[:, None] & (gi[:, None] <= gj[None, :]))[:, :, None, None]
+        s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep_rows, x, jnp.zeros_like(x)))
+        s_full = coll.psum_axis(s_part, ROW_AXIS)
+        newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
+        newrow = jnp.where(
+            (gj == k)[:, None, None], tkk[None], jnp.where(right, newrow, xr)
+        )
+        return _spmd.put_row(x, jnp.where(myr == kr, newrow, xr), lkr)
+
+    x = lax.fori_loop(0, g.mt, body, x)
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
+_cache = {}
+
+
+def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
+    """In-place triangular inverse of the ``uplo`` triangle of A (the other
+    triangle is not referenced and returned unchanged structure-wise)."""
+    if mat_a.size.rows != mat_a.size.cols or mat_a.block_size.rows != mat_a.block_size.cols:
+        raise ValueError("trtri: A must be square with square tiles")
+    g = _spmd.Geometry.of(mat_a.dist)
+    if g.mt == 0:
+        return mat_a
+    key = (id(mat_a.grid.mesh), uplo, diag, g)
+    if key not in _cache:
+        kern_fn = _trtri_lower_kernel if uplo == t.LOWER else _trtri_upper_kernel
+        _cache[key] = coll.spmd(
+            mat_a.grid, partial(kern_fn, g=g, diag=diag), donate_argnums=(0,)
+        )
+    return mat_a.like(_cache[key](mat_a.data))
+
+
+def inverse_from_cholesky_factor(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:
+    """Given the Cholesky factor in the ``uplo`` triangle of A (as produced by
+    cholesky_factorization), return A^-1 with FULL Hermitian storage
+    (reference: inverse_from_cholesky_factor, inverse/cholesky.h:38)."""
+    from dlaf_tpu.algorithms.multiplication import general_multiplication
+
+    tinv = triangular_inverse(uplo, t.NON_UNIT, mat_a)
+    tri = mutil.extract_triangle(tinv, uplo)
+    out = DistributedMatrix(tinv.dist, tinv.grid, jnp.zeros_like(tinv.data))
+    if uplo == t.LOWER:
+        # A^-1 = L^-H L^-1
+        return general_multiplication(t.CONJ_TRANS, t.NO_TRANS, 1.0, tri, tri, 0.0, out)
+    # A^-1 = U^-1 U^-H
+    return general_multiplication(t.NO_TRANS, t.CONJ_TRANS, 1.0, tri, tri, 0.0, out)

@@ -1,0 +1,77 @@
+"""Matrix-level utilities on stacked block-cyclic storage.
+
+Analogues of reference helpers scattered through matrix/util_matrix.h and
+lapack laset/lacpy tile loops: triangle extraction, diagonal set, elementwise
+masks expressed directly on the stacked [Pr, Pc, ltr, ltc, mb, nb] array
+(pure elementwise XLA ops — they stay sharded, no communication).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dlaf_tpu.matrix.distribution import Distribution
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def _global_element_grids(dist: Distribution):
+    """Broadcastable global (row, col) element indices for the stacked shape."""
+    pr, pc = dist.grid_size
+    ltr, ltc = dist.local_slots
+    mb, nb = dist.block_size
+    sr, sc = dist.source_rank
+    r = jnp.arange(pr).reshape(pr, 1, 1, 1, 1, 1)
+    c = jnp.arange(pc).reshape(1, pc, 1, 1, 1, 1)
+    li = jnp.arange(ltr).reshape(1, 1, ltr, 1, 1, 1)
+    lj = jnp.arange(ltc).reshape(1, 1, 1, ltc, 1, 1)
+    a = jnp.arange(mb).reshape(1, 1, 1, 1, mb, 1)
+    b = jnp.arange(nb).reshape(1, 1, 1, 1, 1, nb)
+    gi = (li * pr + (r - sr) % pr) * mb + a
+    gj = (lj * pc + (c - sc) % pc) * nb + b
+    return gi, gj
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def _triangle_data(x, dist: Distribution, uplo: str, k: int):
+    gi, gj = _global_element_grids(dist)
+    keep = (gi >= gj - k) if uplo == "L" else (gi <= gj + k)
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+def extract_triangle(mat: DistributedMatrix, uplo: str, k: int = 0) -> DistributedMatrix:
+    """Return a copy with only the ``uplo`` triangle kept (diagonal offset
+    ``k`` as in np.tril/triu)."""
+    return mat.like(_triangle_data(mat.data, mat.dist, uplo, k))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _hermitize_lower(x, dist: Distribution):
+    # not a pure elementwise op; provided at matrix level via transpose util
+    raise NotImplementedError
+
+
+@partial(jax.jit, static_argnums=(1, 4))
+def _set_diag_data(x, dist: Distribution, alpha, beta, overwrite_all: bool):
+    gi, gj = _global_element_grids(dist)
+    m, n = dist.size
+    inside = (gi < m) & (gj < n)
+    diag = (gi == gj) & inside
+    if overwrite_all:
+        off = jnp.where(inside, jnp.full_like(x, alpha), jnp.zeros_like(x))
+        return jnp.where(diag, jnp.full_like(x, beta), off)
+    return jnp.where(diag, jnp.full_like(x, beta), x)
+
+
+def laset(mat: DistributedMatrix, alpha, beta) -> DistributedMatrix:
+    """Set all elements to alpha, diagonal to beta (lapack laset analogue)."""
+    return mat.like(_set_diag_data(mat.data, mat.dist, alpha, beta, True))
+
+
+def set_diagonal(mat: DistributedMatrix, beta) -> DistributedMatrix:
+    return mat.like(_set_diag_data(mat.data, mat.dist, 0.0, beta, False))
+
+
+def eye_like(mat: DistributedMatrix) -> DistributedMatrix:
+    return laset(mat, 0.0, 1.0)

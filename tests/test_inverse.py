@@ -1,0 +1,56 @@
+"""Distributed TRTRI / POTRI tests
+(reference: test/unit/inverse/test_triangular.cpp, test_cholesky.cpp)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.inverse import inverse_from_cholesky_factor, triangular_inverse
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+@pytest.mark.parametrize("uplo,diag", itertools.product("LU", "NU"))
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_trtri(grid_2x4, uplo, diag, dtype):
+    m, mb = 13, 4
+    a = tu.random_triangular(m, dtype, lower=(uplo == "L"), unit=False, seed=2)
+    # poison the unreferenced triangle
+    poison = (np.triu(np.ones((m, m)), 1) if uplo == "L" else np.tril(np.ones((m, m)), -1)) * 4.2
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(tri, 1.0)
+    expected = np.linalg.inv(tri)
+    mat = DistributedMatrix.from_global(grid_2x4, a + poison, (mb, mb))
+    out = triangular_inverse(uplo, diag, mat)
+    tu.assert_near(out, expected, tu.tol_for(dtype, m, 500.0), uplo=uplo)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_trtri_grids_sizes(comm_grids, dtype):
+    for m, mb in [(3, 4), (8, 4), (21, 5)]:
+        a = tu.random_triangular(m, dtype, lower=True, seed=m)
+        expected = np.linalg.inv(a)
+        for grid in comm_grids[:3]:
+            mat = DistributedMatrix.from_global(grid, a, (mb, mb))
+            out = triangular_inverse("L", "N", mat)
+            tu.assert_near(out, expected, tu.tol_for(dtype, m, 500.0), uplo="L")
+
+
+@pytest.mark.parametrize("uplo", "LU")
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_potri(grid_2x4, uplo, dtype):
+    m, mb = 12, 4
+    a = tu.random_hermitian_pd(m, dtype, seed=9)
+    expected = np.linalg.inv(a)
+    if uplo == "L":
+        mat = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+        fac = cholesky_factorization("L", mat)
+        out = inverse_from_cholesky_factor("L", fac)
+    else:
+        u = np.linalg.cholesky(a).conj().T
+        mat = DistributedMatrix.from_global(grid_2x4, u, (mb, mb))
+        out = inverse_from_cholesky_factor("U", mat)
+    tu.assert_near(out, expected, tu.tol_for(dtype, m, 1000.0))
