@@ -1,0 +1,41 @@
+"""Generalized-to-standard eigenproblem reduction (HEGST type 1).
+
+TPU-native analogue of the reference gen_to_std
+(reference: include/dlaf/eigensolver/gen_to_std.h:50-101 and
+eigensolver/gen_to_std/impl.h, 769 lines of tiled hegst/trsm/hemm/her2k).
+Given B = L L^H (factor in ``mat_b``), transforms A of A x = lambda B x into
+the standard form  A_std := L^-1 A L^-H.
+
+Rather than porting the reference's fused tile recursion, we compose the
+existing distributed kernels — hermitize(A), then two triangular solves:
+
+    A1 = L^-1 A          (Left, Lower, NoTrans)
+    A_std = A1 L^-H      (Right, Lower, ConjTrans)
+
+which is the same 2 x N^3 flop count as hegst expressed as two dense sweeps
+that XLA pipelines; full Hermitian storage in, full Hermitian storage out.
+"""
+from __future__ import annotations
+
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix import util as mutil
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+def generalized_to_standard(
+    uplo: str, mat_a: DistributedMatrix, mat_b: DistributedMatrix
+) -> DistributedMatrix:
+    """A := inv(fac) A inv(fac)^H with fac = L (uplo=L, B = L L^H) or
+    fac = U^H ... (uplo=U, B = U^H U: A := U^-H A U^-1).
+
+    ``mat_a``: Hermitian, ``uplo`` triangle valid.  ``mat_b``: Cholesky
+    factor in the ``uplo`` triangle.  Returns A_std with FULL Hermitian
+    storage (superset of the reference's single-triangle result).
+    """
+    a_full = mutil.hermitize(mat_a, uplo)
+    if uplo == t.LOWER:
+        a1 = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_b, a_full)
+        return triangular_solver(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, mat_b, a1)
+    a1 = triangular_solver(t.LEFT, t.UPPER, t.CONJ_TRANS, t.NON_UNIT, 1.0, mat_b, a_full)
+    return triangular_solver(t.RIGHT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_b, a1)

@@ -36,7 +36,8 @@ def _global_element_grids(dist: Distribution):
 @partial(jax.jit, static_argnums=(1, 2, 3))
 def _triangle_data(x, dist: Distribution, uplo: str, k: int):
     gi, gj = _global_element_grids(dist)
-    keep = (gi >= gj - k) if uplo == "L" else (gi <= gj + k)
+    # np convention: tril keeps i >= j - k, triu keeps i <= j - k
+    keep = (gi >= gj - k) if uplo == "L" else (gi <= gj - k)
     return jnp.where(keep, x, jnp.zeros_like(x))
 
 
@@ -46,10 +47,45 @@ def extract_triangle(mat: DistributedMatrix, uplo: str, k: int = 0) -> Distribut
     return mat.like(_triangle_data(mat.data, mat.dist, uplo, k))
 
 
-@partial(jax.jit, static_argnums=(1,))
-def _hermitize_lower(x, dist: Distribution):
-    # not a pure elementwise op; provided at matrix level via transpose util
-    raise NotImplementedError
+def _transpose_data(x, dist: Distribution, dist_t: Distribution, conj: bool):
+    from dlaf_tpu.matrix import layout
+
+    g = layout.unpack(x, dist)
+    gt = jnp.swapaxes(g, 0, 1)
+    if conj:
+        gt = gt.conj()
+    return layout.pack(gt, dist_t)
+
+
+def transpose(mat: DistributedMatrix, conj: bool = False) -> DistributedMatrix:
+    """Distributed (conjugate) transpose.
+
+    Expressed as unpack -> global transpose -> repack, all inside one jit:
+    XLA lowers the resharding to an all-to-all over the mesh.  (The reference
+    has no full transpose; its transposed panels are the per-step
+    broadcast_panel trick — see collectives.transpose_panel.)"""
+    d = mat.dist
+    dist_t = Distribution(
+        (d.size.cols, d.size.rows),
+        (d.block_size.cols, d.block_size.rows),
+        d.grid_size,
+        (d.source_rank.col, d.source_rank.row),
+    )
+    fn = jax.jit(partial(_transpose_data, dist=d, dist_t=dist_t, conj=conj))
+    out = fn(mat.data)
+    out = jax.device_put(out, mat.grid.stacked_sharding())
+    return DistributedMatrix(dist_t, mat.grid, out)
+
+
+def hermitize(mat: DistributedMatrix, uplo: str) -> DistributedMatrix:
+    """Build full Hermitian storage from the ``uplo`` triangle (the other
+    triangle's stored values are ignored)."""
+    if mat.size.rows != mat.size.cols:
+        raise ValueError("hermitize: matrix must be square")
+    tri = extract_triangle(mat, uplo)
+    strict = extract_triangle(mat, uplo, k=-1 if uplo == "L" else 1)
+    mirror = transpose(strict, conj=True)
+    return mat.like(tri.data + mirror.data)
 
 
 @partial(jax.jit, static_argnums=(1, 4))

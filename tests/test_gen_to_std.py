@@ -1,0 +1,68 @@
+"""hermitize/transpose utilities + HEGST tests
+(reference: test/unit/eigensolver/test_gen_to_std.cpp)."""
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+from dlaf_tpu.matrix import util as mutil
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def test_transpose(comm_grids):
+    a = tu.random_matrix(13, 9, np.complex128, seed=1)
+    for grid in comm_grids[:3]:
+        m = DistributedMatrix.from_global(grid, a, (4, 4))
+        mt = mutil.transpose(m, conj=True)
+        np.testing.assert_allclose(mt.to_global(), a.conj().T)
+        assert tuple(mt.size) == (9, 13)
+
+
+def test_hermitize(grid_2x4):
+    h = tu.random_hermitian_pd(11, np.complex128, seed=2)
+    lo = np.tril(h) + np.triu(np.ones_like(h), 1) * 9.9  # poison upper
+    m = DistributedMatrix.from_global(grid_2x4, lo, (4, 4))
+    out = mutil.hermitize(m, "L")
+    np.testing.assert_allclose(out.to_global(), h, atol=1e-12)
+
+
+@pytest.mark.parametrize("uplo", "LU")
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_gen_to_std(grid_2x4, uplo, dtype):
+    m, mb = 13, 4
+    a = tu.random_hermitian_pd(m, dtype, seed=3)
+    b = tu.random_hermitian_pd(m, dtype, seed=4)
+    l = np.linalg.cholesky(b)
+    if uplo == "L":
+        expected = np.linalg.solve(l, a) @ np.linalg.inv(l.conj().T)
+        fac = l
+    else:
+        u = l.conj().T
+        expected = np.linalg.solve(u.conj().T, a) @ np.linalg.inv(u)
+        fac = u
+    tol = tu.tol_for(dtype, m, 500.0)
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    mat_a = DistributedMatrix.from_global(grid_2x4, tri, (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, fac, (mb, mb))
+    out = generalized_to_standard(uplo, mat_a, mat_b)
+    tu.assert_near(out, expected, tol)
+    # result is Hermitian full storage
+    g = out.to_global()
+    np.testing.assert_allclose(g, g.conj().T, atol=1e-8)
+
+
+def test_gen_to_std_with_cholesky_pipeline(grid_2x4):
+    """End-to-end: cholesky(B) then hegst, as gen_eigensolver will chain."""
+    m, mb = 16, 4
+    dtype = np.float64
+    a = tu.random_hermitian_pd(m, dtype, seed=5)
+    b = tu.random_hermitian_pd(m, dtype, seed=6)
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    fac = cholesky_factorization("L", mat_b)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (mb, mb))
+    out = generalized_to_standard("L", mat_a, fac)
+    l = np.linalg.cholesky(b)
+    expected = np.linalg.solve(l, a) @ np.linalg.inv(l.conj().T)
+    tu.assert_near(out, expected, tu.tol_for(dtype, m, 500.0))
