@@ -76,7 +76,10 @@ def select_local_tiles(panel_global, local_count: int, grid_dim, my_coord, src=0
     this rank's block-cyclic subset ``[local_count, ...]``
     (tile ``lt`` -> global ``lt*P + (my - src) % P``)."""
     idx = jnp.arange(local_count) * grid_dim + (my_coord - src) % grid_dim
-    return jnp.take(panel_global, idx, axis=0)
+    n = panel_global.shape[0]
+    valid = (idx < n).reshape((local_count,) + (1,) * (panel_global.ndim - 1))
+    taken = jnp.take(panel_global, jnp.clip(idx, 0, n - 1), axis=0)
+    return jnp.where(valid, taken, jnp.zeros_like(taken))
 
 
 def transpose_panel(cp, nr_row_tiles, ltc: int):

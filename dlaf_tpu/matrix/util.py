@@ -50,11 +50,13 @@ def extract_triangle(mat: DistributedMatrix, uplo: str, k: int = 0) -> Distribut
 def _transpose_data(x, dist: Distribution, dist_t: Distribution, conj: bool):
     from dlaf_tpu.matrix import layout
 
-    g = layout.unpack(x, dist)
+    # unpad before transposing: source and target padded extents differ in
+    # general (e.g. 8x16 padded vs 16x8) even though element counts match
+    g = layout.unpad_global(layout.unpack(x, dist), dist)
     gt = jnp.swapaxes(g, 0, 1)
     if conj:
         gt = gt.conj()
-    return layout.pack(gt, dist_t)
+    return layout.pack(layout.pad_global(gt, dist_t), dist_t)
 
 
 def transpose(mat: DistributedMatrix, conj: bool = False) -> DistributedMatrix:

@@ -1,0 +1,78 @@
+"""reduction_to_band tests (reference: test/unit/eigensolver/
+test_reduction_to_band.cpp): reconstruct Q from the stored reflectors/taus
+and verify Q^H A Q equals the returned band, plus band structure."""
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def reconstruct_q(out_global, taus, m, nb):
+    q = np.eye(m, dtype=out_global.dtype)
+    n_panels = taus.shape[0]
+    for k in range(n_panels):
+        for j in range(nb):
+            s = (k + 1) * nb + j
+            c = k * nb + j
+            if s >= m or c >= m:
+                break
+            v = np.zeros(m, dtype=out_global.dtype)
+            v[s] = 1.0
+            v[s + 1 :] = out_global[s + 1 :, c]
+            q = q @ (np.eye(m, dtype=out_global.dtype) - taus[k, j] * np.outer(v, v.conj()))
+    return q
+
+
+def band_mask(m, nb):
+    """Element-level band |i-j| <= nb."""
+    i, j = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
+    return np.abs(i - j) <= nb
+
+
+@pytest.mark.parametrize("m,nb", [(8, 4), (13, 4), (16, 4), (20, 5)])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_reduction_to_band(grid_2x4, m, nb, dtype):
+    a = tu.random_hermitian_pd(m, dtype, seed=m)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    out, taus = reduction_to_band(mat)
+    og = out.to_global()
+    taus_h = np.asarray(taus)
+    q = reconstruct_q(og, taus_h, m, nb)
+    # Q unitary
+    np.testing.assert_allclose(q.conj().T @ q, np.eye(m), atol=1e-10)
+    ref = q.conj().T @ a @ q
+    # the transform result must be band
+    off = ref[~band_mask(m, nb)]
+    assert off.size == 0 or np.max(np.abs(off)) < tu.tol_for(dtype, m, 100.0)
+    # lower band region of the output equals the transform
+    bm = band_mask(m, nb) & (np.tril(np.ones((m, m))) > 0)
+    np.testing.assert_allclose(
+        og[bm], ref[bm], atol=tu.tol_for(dtype, m, 100.0) * np.abs(a).max()
+    )
+    # eigenvalues preserved
+    band_full = np.where(bm, ref, 0)
+    band_herm = np.tril(band_full) + np.tril(band_full, -1).conj().T
+    np.testing.assert_allclose(
+        np.linalg.eigvalsh(band_herm), np.linalg.eigvalsh(a), atol=tu.tol_for(dtype, m, 100.0)
+    )
+
+
+def test_reduction_to_band_grids(comm_grids):
+    m, nb = 12, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=1)
+    for grid in comm_grids[:4]:
+        mat = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+        out, taus = reduction_to_band(mat)
+        q = reconstruct_q(out.to_global(), np.asarray(taus), m, nb)
+        ref = q.conj().T @ a @ q
+        off = ref[~band_mask(m, nb)]
+        assert off.size == 0 or np.max(np.abs(off)) < 1e-10
+
+
+def test_reduction_single_tile(grid_2x4):
+    a = tu.random_hermitian_pd(4, np.float64, seed=2)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (4, 4))
+    out, taus = reduction_to_band(mat)
+    assert taus.shape[0] == 0
