@@ -1,0 +1,65 @@
+"""dlaf_tpu — TPU-native distributed dense linear algebra.
+
+A brand-new JAX/XLA framework with the capabilities of eth-cscs/DLA-Future
+(see SURVEY.md): ScaLAPACK-class algorithms on 2D block-cyclic matrices over
+a device mesh, with XLA collectives in place of MPI and jitted SPMD programs
+in place of the pika task graph.
+
+Public surface mirrors the reference's umbrella headers
+(include/dlaf/{factorization,solver,multiplication,inverse,eigensolver,
+auxiliary}.h).
+"""
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index import Index2D, Size2D
+from dlaf_tpu.matrix.distribution import Distribution
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.algorithms.multiplication import (
+    general_multiplication,
+    hermitian_multiplication,
+    triangular_multiplication,
+)
+from dlaf_tpu.algorithms.inverse import (
+    inverse_from_cholesky_factor,
+    triangular_inverse,
+)
+from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
+from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
+from dlaf_tpu.algorithms.bt_band_to_tridiag import bt_band_to_tridiagonal
+from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
+from dlaf_tpu.algorithms.eigensolver import (
+    EigResult,
+    hermitian_eigensolver,
+    hermitian_generalized_eigensolver,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Grid",
+    "Index2D",
+    "Size2D",
+    "Distribution",
+    "DistributedMatrix",
+    "cholesky_factorization",
+    "triangular_solver",
+    "general_multiplication",
+    "hermitian_multiplication",
+    "triangular_multiplication",
+    "inverse_from_cholesky_factor",
+    "triangular_inverse",
+    "generalized_to_standard",
+    "reduction_to_band",
+    "band_to_tridiagonal",
+    "tridiagonal_eigensolver",
+    "bt_band_to_tridiagonal",
+    "bt_reduction_to_band",
+    "EigResult",
+    "hermitian_eigensolver",
+    "hermitian_generalized_eigensolver",
+    "__version__",
+]

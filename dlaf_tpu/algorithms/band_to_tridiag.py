@@ -1,0 +1,89 @@
+"""Band -> real symmetric tridiagonal reduction (host stage).
+
+TPU-native placement of the reference band_to_tridiagonal
+(reference: include/dlaf/eigensolver/band_to_tridiag.h:106-174 and
+band_to_tridiag/mc.h — bulge-chasing SweepWorker pipeline, **CPU-only** in
+the reference too, api.h:40-46).  The band is O(N*nb) data — tiny next to
+the N^2 matrix — so like the reference we hop to the host for this
+sequential stage: gather the band, reduce to tridiagonal on CPU, and return
+the orthogonal/unitary transformation for the back-transform stage.
+
+Round-1 implementation detail: the host reduction uses LAPACK via scipy
+(Hessenberg reduction of the dense band matrix + phase normalization for the
+complex case).  A native C++ bulge-chasing kernel that exploits bandedness
+(O(N^2 b) instead of O(N^3)) replaces this in dlaf_tpu/native.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import scipy.linalg as sla
+
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+@dataclass
+class BandToTridiagResult:
+    """d, e: real tridiagonal (diagonal / off-diagonal); q2: host (n x n)
+    transformation with q2^H B q2 = tridiag (the reference returns the
+    equivalent compact HH reflector matrix)."""
+
+    d: np.ndarray
+    e: np.ndarray
+    q2: np.ndarray
+
+
+def extract_band_host(mat: DistributedMatrix, band: int) -> np.ndarray:
+    """Gather the Hermitian band (lower storage) to a dense host matrix,
+    tile by tile (O(N*nb) transfers; never materializes N^2 on device)."""
+    m = mat.size.rows
+    nb = mat.block_size.rows
+    a = np.zeros((m, m), dtype=np.dtype(mat.dtype))
+    mt = mat.nr_tiles.rows
+    for i in range(mt):
+        dt = mat.get_tile((i, i))
+        r0 = i * nb
+        sz = dt.shape[0]
+        a[r0 : r0 + sz, r0 : r0 + sz] = np.tril(dt)
+        if i + 1 < mt:
+            st = mat.get_tile((i + 1, i))
+            r1 = (i + 1) * nb
+            sz1 = st.shape[0]
+            # only the band part (upper triangle incl diag) of the subdiag
+            # tile is band data; below it live red2band reflector tails
+            a[r1 : r1 + sz1, r0 : r0 + sz] = np.triu(st)
+    # element-level band mask (defensive: drop anything outside the band)
+    i, j = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
+    a = np.where((i - j > band) | (i < j), 0, a)
+    return a + np.tril(a, -1).conj().T
+
+
+def band_to_tridiagonal(mat_band: DistributedMatrix, band: int | None = None) -> BandToTridiagResult:
+    """Reduce the banded Hermitian matrix (band in the lower triangle of
+    ``mat_band``, as produced by reduction_to_band) to real symmetric
+    tridiagonal form.  Returns (d, e, q2)."""
+    if band is None:
+        band = mat_band.block_size.rows
+    m = mat_band.size.rows
+    dt = np.dtype(mat_band.dtype)
+    if m == 0:
+        rd = np.float32 if dt.itemsize <= 8 and dt.kind != "c" and dt.itemsize == 4 else np.float64
+        return BandToTridiagResult(np.zeros(0, rd), np.zeros(0, rd), np.zeros((0, 0), dt))
+    a = extract_band_host(mat_band, band)
+    h, q = sla.hessenberg(a, calc_q=True)
+    d = np.real(np.diagonal(h)).copy()
+    e_raw = np.diagonal(h, -1).copy()
+    if dt.kind == "c":
+        # phase-normalize the subdiagonal so the tridiagonal is real:
+        # (Q D)^H A (Q D) with D = diag of accumulated phases
+        phases = np.ones(m, dtype=dt)
+        for j in range(m - 1):
+            ph = e_raw[j] / np.abs(e_raw[j]) if np.abs(e_raw[j]) > 0 else 1.0
+            phases[j + 1] = phases[j] * ph
+        q = q * phases[None, :]
+        e = np.abs(e_raw)
+    else:
+        e = np.real(e_raw).copy()
+    rd = np.float32 if dt in (np.dtype(np.float32), np.dtype(np.complex64)) else np.float64
+    return BandToTridiagResult(d.astype(rd), e.astype(rd), q)

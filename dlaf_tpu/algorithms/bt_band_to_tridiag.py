@@ -1,0 +1,33 @@
+"""Back-transform of eigenvectors by the band->tridiagonal transformation:
+E <- Q2 E.
+
+TPU-native analogue of the reference bt_band_to_tridiagonal
+(reference: include/dlaf/eigensolver/bt_band_to_tridiag.h:55-136 and
+bt_band_to_tridiag/impl.h — grouped HH applications with sub-b x b tiling).
+Here Q2 comes from the host band stage as an explicit matrix
+(band_to_tridiag.py); the back-transform is a distributed GEMM on the mesh —
+the form in which TPUs want this O(N^2 k) work anyway.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from dlaf_tpu.algorithms.multiplication import general_multiplication
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+def bt_band_to_tridiagonal(
+    q2_host: np.ndarray, mat_e: DistributedMatrix
+) -> DistributedMatrix:
+    """E := Q2 E."""
+    import jax.numpy as jnp
+
+    if q2_host.shape[0] == 0 or mat_e.size.count() == 0:
+        return mat_e
+    mb = mat_e.block_size.rows
+    q2 = DistributedMatrix.from_global(
+        mat_e.grid, q2_host.astype(np.dtype(mat_e.dtype)), (mb, mb)
+    )
+    out = DistributedMatrix(mat_e.dist, mat_e.grid, jnp.zeros_like(mat_e.data))
+    return general_multiplication(t.NO_TRANS, t.NO_TRANS, 1.0, q2, mat_e, 0.0, out)

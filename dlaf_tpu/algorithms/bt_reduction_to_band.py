@@ -1,0 +1,95 @@
+"""Back-transform of eigenvectors by the reduction-to-band reflectors:
+E <- Q1 E with Q1 = prod_k (I - V_k T_k V_k^H).
+
+TPU-native re-design of the reference bt_reduction_to_band
+(reference: include/dlaf/eigensolver/bt_reduction_to_band.h:47-108 and
+bt_reduction_to_band/impl.h — compact-WY applications with recomputed T
+factors).  One jitted SPMD fori_loop over panels in REVERSE order; per panel:
+
+  1. gather the stored reflector column from the band matrix (all_gather
+     along 'r' + bcast along 'c'), rebuild V (unit heads, zero above),
+  2. recompute the T factor (same _t_factor as reduction_to_band — the
+     reference also recomputes T, impl.h:399),
+  3. W = T^H? no — E := E - V T (V^H E): V^H E is a psum over 'r', the
+     rank-nb update is one batched einsum.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.algorithms import _spmd
+from dlaf_tpu.algorithms.reduction_to_band import _t_factor
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def _bt_r2b_kernel(a, taus, e, g_a: _spmd.Geometry, g_e: _spmd.Geometry, n_panels: int):
+    a = coll.local(a)
+    e = coll.local(e)
+    taus = coll.local(taus)
+    myr, myc = coll.my_rank()
+    gi = _spmd.local_row_tiles(g_a, myr)
+    np_ = g_a.ltr * g_a.pr * g_a.mb
+    rows = jnp.arange(np_)
+
+    def body(s, e):
+        k = n_panels - 1 - s
+        kc = k % g_a.pc
+        lkc = k // g_a.pc
+        # 1. gather stored reflector column, rebuild V
+        xc = _spmd.take_col(a, lkc, g_a)
+        gat = coll.all_gather_axis(xc, ROW_AXIS)
+        col = jnp.transpose(gat, (1, 0, 2, 3)).reshape(np_, g_a.nb)
+        col = coll.bcast(col.reshape(np_ // g_a.mb, g_a.mb, g_a.nb), kc, COL_AXIS).reshape(
+            np_, g_a.nb
+        )
+        start = (k + 1) * g_a.mb
+        j_idx = jnp.arange(g_a.nb)[None, :]
+        head = rows[:, None] == start + j_idx
+        below = rows[:, None] > start + j_idx
+        v = jnp.where(head, 1.0, jnp.where(below, col, 0.0)).astype(col.dtype)
+        tau_k = lax.dynamic_slice(taus, (k, 0), (1, g_a.nb))[0]
+        # zero columns whose tau is 0 (incl. padding columns)
+        v = jnp.where((tau_k == 0)[None, :], 0.0, v)
+        tmat = _t_factor(v, tau_k, g_a.nb)
+        # 2. E -= V T (V^H E)
+        v_tiles = v.reshape(np_ // g_a.mb, g_a.mb, g_a.nb)
+        vr = jnp.take(v_tiles, gi, axis=0)  # [ltr, mb, nb]
+        w = coll.psum_axis(jnp.einsum("iab,ijac->jbc", vr.conj(), e), ROW_AXIS)
+        tw = jnp.einsum("ab,jbc->jac", tmat, w)
+        return e - jnp.einsum("iab,jbc->ijac", vr, tw)
+
+    e = lax.fori_loop(0, n_panels, body, e)
+    return coll.relocal(e)
+
+
+_cache = {}
+
+
+def bt_reduction_to_band(
+    mat_e: DistributedMatrix, mat_band: DistributedMatrix, taus: jax.Array
+) -> DistributedMatrix:
+    """E := Q1 E where Q1 is the accumulated reduction_to_band transformation
+    stored in ``mat_band`` (reflector tails below the band) + ``taus``."""
+    g_a = _spmd.Geometry.of(mat_band.dist)
+    g_e = _spmd.Geometry.of(mat_e.dist)
+    if g_a.mb != g_e.mb or g_a.pr != g_e.pr or g_a.mt != g_e.mt:
+        raise ValueError("bt_reduction_to_band: E row distribution must match A")
+    n_panels = int(taus.shape[0])
+    if n_panels == 0 or g_e.nt == 0:
+        return mat_e
+    # taus replicated: stack to [Pr, Pc, n_panels, nb]
+    taus_stacked = jnp.broadcast_to(
+        taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
+    )
+    taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
+    key = (id(mat_e.grid.mesh), g_a, g_e, n_panels)
+    if key not in _cache:
+        kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels)
+        _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
+    return mat_e.like(_cache[key](mat_band.data, taus_stacked, mat_e.data))

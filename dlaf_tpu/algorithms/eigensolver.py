@@ -1,0 +1,89 @@
+"""Hermitian (generalized) eigensolver orchestration.
+
+TPU-native analogue of the reference eigensolver drivers
+(reference: include/dlaf/eigensolver/eigensolver.h:39-256,
+eigensolver/eigensolver/impl.h:37-106 — HEEV pipeline; gen_eigensolver.h:67-99,
+gen_eigensolver/impl.h:31-105 — HEGV).  Pipeline (same staging as the
+reference):
+
+  reduction_to_band  (distributed, device)         impl.h:85
+  band_to_tridiagonal (host, like the reference's CPU-only stage) impl.h:87
+  tridiagonal_eigensolver (host MRRR for now)      impl.h:89
+  bt_band_to_tridiagonal (distributed GEMM)        impl.h:94
+  bt_reduction_to_band (distributed WY applies)    impl.h:95
+
+Partial spectrum via eigenvalue index range (MatrixRef col-slice in the
+reference, eigensolver/impl.h:52-57) maps to a narrower eigenvector matrix.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
+from dlaf_tpu.algorithms.bt_band_to_tridiag import bt_band_to_tridiagonal
+from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
+from dlaf_tpu.matrix import util as mutil
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+@dataclass
+class EigResult:
+    eigenvalues: np.ndarray  # ascending, host
+    eigenvectors: DistributedMatrix  # n x k distributed
+
+
+def hermitian_eigensolver(
+    uplo: str,
+    mat_a: DistributedMatrix,
+    spectrum: Optional[Tuple[int, int]] = None,
+) -> EigResult:
+    """Eigendecomposition of the Hermitian matrix stored in the ``uplo``
+    triangle of ``mat_a``.  ``spectrum=(il, iu)`` selects the eigenvalue
+    index range (inclusive, 0-based)."""
+    if uplo == t.UPPER:
+        # lower-storage pipeline on the mirrored matrix
+        mat_a = mutil.extract_triangle(mutil.hermitize(mat_a, "U"), "L")
+        uplo = t.LOWER
+    grid = mat_a.grid
+    nb = mat_a.block_size.rows
+    band_mat, taus = reduction_to_band(mat_a)
+    b2t = band_to_tridiagonal(band_mat)
+    evals, e_tri = tridiagonal_eigensolver(
+        grid, b2t.d, b2t.e, nb, dtype=mat_a.dtype, spectrum=spectrum
+    )
+    e = bt_band_to_tridiagonal(b2t.q2, e_tri)
+    e = bt_reduction_to_band(e, band_mat, taus)
+    return EigResult(evals, e)
+
+
+def hermitian_generalized_eigensolver(
+    uplo: str,
+    mat_a: DistributedMatrix,
+    mat_b: DistributedMatrix,
+    spectrum: Optional[Tuple[int, int]] = None,
+    factorized: bool = False,
+) -> EigResult:
+    """Solve A x = lambda B x (A Hermitian, B Hermitian positive definite).
+
+    ``factorized=True`` means ``mat_b`` already holds the Cholesky factor
+    (reference hermitian_generalized_eigensolver_factorized,
+    gen_eigensolver.h:99)."""
+    fac = mat_b if factorized else cholesky_factorization(uplo, mat_b)
+    a_std = generalized_to_standard(uplo, mat_a, fac)
+    a_tri = mutil.extract_triangle(a_std, uplo)
+    res = hermitian_eigensolver(uplo, a_tri, spectrum=spectrum)
+    # back-substitute: x = L^-H y (uplo=L) / U^-1 y (uplo=U)
+    if uplo == t.LOWER:
+        e = triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
+    else:
+        e = triangular_solver(t.LEFT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
+    return EigResult(res.eigenvalues, e)

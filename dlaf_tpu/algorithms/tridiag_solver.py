@@ -1,0 +1,54 @@
+"""Symmetric tridiagonal eigensolver.
+
+TPU-native placement of the reference tridiag_solver
+(reference: include/dlaf/eigensolver/tridiag_solver.h:44-121 and
+tridiag_solver/{impl,merge}.h — distributed Cuppen divide & conquer,
+~3200 LoC).  Round-1 implementation: the tridiagonal problem is O(N) data;
+we solve it on host with LAPACK MRRR (?stemr via scipy eigh_tridiagonal) and
+distribute the eigenvector matrix — the reference's D&C exists to scale the
+O(N^2..3) eigenvector work, which for us is absorbed by the back-transform
+GEMMs on device.  A JAX-native D&C (deflation via masked sorts, vectorized
+secular-equation Newton solve) is the planned replacement
+(SURVEY.md §7 M5d).
+
+Supports the reference's partial-spectrum interface (eigenvalue index
+range), eigensolver/eigensolver.h:39 partial spectrum overloads.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import scipy.linalg as sla
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def tridiagonal_eigensolver(
+    grid: Grid,
+    d: np.ndarray,
+    e: np.ndarray,
+    block_size: int,
+    dtype=np.float64,
+    spectrum: Optional[Tuple[int, int]] = None,
+) -> Tuple[np.ndarray, DistributedMatrix]:
+    """Eigendecomposition of the real symmetric tridiagonal (d, e).
+
+    Returns (eigenvalues ascending [host], eigenvector DistributedMatrix of
+    shape n x k distributed over ``grid``).  ``spectrum=(il, iu)`` selects
+    eigenvalue indices il..iu inclusive (0-based), mirroring the reference's
+    eigenvalues_index_begin/end."""
+    n = d.shape[0]
+    if n == 0:
+        w = np.zeros(0, np.dtype(dtype))
+        mat = DistributedMatrix.zeros(grid, (0, 0), (block_size, block_size), dtype)
+        return w, mat
+    if spectrum is None:
+        w, v = sla.eigh_tridiagonal(d, e)
+    else:
+        il, iu = spectrum
+        w, v = sla.eigh_tridiagonal(d, e, select="i", select_range=(il, iu))
+    v = v.astype(np.dtype(dtype))
+    mat = DistributedMatrix.from_global(grid, v, (block_size, block_size))
+    return w.astype(v.real.dtype if np.dtype(dtype).kind == "c" else np.dtype(dtype)), mat

@@ -32,12 +32,18 @@ NON_UNIT = "N"
 
 def potrf(a, lower: bool = True):
     """Cholesky of a (batch of) Hermitian tile(s) (tile::potrf,
-    lapack/tile.h).  Returns the triangular factor with the other triangle
-    zeroed (jnp.linalg.cholesky semantics)."""
+    lapack/tile.h).  LAPACK semantics: ONLY the ``lower`` triangle is
+    referenced (jnp.linalg.cholesky would instead symmetrize the full tile,
+    silently halving off-diagonals of triangle-only storage); the Hermitian
+    tile is rebuilt from the stored triangle first.  Returns the triangular
+    factor with the other triangle zeroed."""
     if lower:
-        return jnp.linalg.cholesky(a)
-    # U = (cholesky(A^H))^H with A Hermitian: factor via lower of conj
-    return _adj(jnp.linalg.cholesky(_adj(a)))
+        tri = jnp.tril(a)
+        herm = tri + _adj(jnp.tril(a, -1))
+        return jnp.linalg.cholesky(herm)
+    tri = jnp.triu(a)
+    herm = tri + _adj(jnp.triu(a, 1))
+    return _adj(jnp.linalg.cholesky(_adj(herm)))
 
 
 def _adj(a):

@@ -27,6 +27,17 @@ def test_cholesky_lower(comm_grids, dtype, m, mb):
         tu.assert_near(out, expected, tol, uplo="L")
 
 
+def test_cholesky_triangle_only_storage(grid_2x4):
+    """Only the uplo triangle may be referenced (LAPACK semantics) —
+    regression: jnp cholesky symmetrization was halving off-diagonals."""
+    m, mb = 13, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=1)
+    stored = np.tril(a) + np.triu(np.ones((m, m)), 1) * 5.5  # poison upper
+    mat = DistributedMatrix.from_global(grid_2x4, stored, (mb, mb))
+    out = cholesky_factorization("L", mat)
+    tu.assert_near(out, np.linalg.cholesky(a), tu.tol_for(np.float64, m, 40.0), uplo="L")
+
+
 def test_cholesky_validation(grid_2x4):
     mat = DistributedMatrix.zeros(grid_2x4, (8, 6), (4, 4))
     with pytest.raises(ValueError):

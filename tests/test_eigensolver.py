@@ -1,0 +1,114 @@
+"""Eigensolver pipeline tests (reference: test/unit/eigensolver/
+test_eigensolver.cpp, test_gen_eigensolver.cpp, test_tridiag_solver.cpp,
+test_band_to_tridiag.cpp, test_bt_*.cpp).
+
+Correctness criteria mirror testEigensolverCorrectness
+(dlaf_test/eigensolver/test_eigensolver_correctness.h:35-79):
+residual ||A V - V Lambda|| and orthogonality ||V^H V - I||."""
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
+from dlaf_tpu.algorithms.eigensolver import (
+    hermitian_eigensolver,
+    hermitian_generalized_eigensolver,
+)
+from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def check_eig(a, evals, evecs, b=None, tol=None):
+    n = a.shape[0]
+    tol = tol or tu.tol_for(a.dtype, n, 500.0)
+    v = evecs
+    bmat = b if b is not None else np.eye(n, dtype=a.dtype)
+    res = a @ v - bmat @ v * evals[None, :]
+    assert np.max(np.abs(res)) < tol * max(1.0, np.abs(a).max()), np.max(np.abs(res))
+    ortho = v.conj().T @ bmat @ v - np.eye(v.shape[1], dtype=a.dtype)
+    assert np.max(np.abs(ortho)) < tol, np.max(np.abs(ortho))
+
+
+@pytest.mark.parametrize("m,nb", [(8, 4), (13, 4), (16, 4), (21, 5)])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_heev(grid_2x4, m, nb, dtype):
+    a = tu.random_hermitian_pd(m, dtype, seed=m)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    res = hermitian_eigensolver("L", mat)
+    evals_ref = np.linalg.eigvalsh(a)
+    np.testing.assert_allclose(res.eigenvalues, evals_ref, atol=tu.tol_for(dtype, m, 500.0))
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+
+
+def test_heev_upper(grid_2x4):
+    m, nb, dtype = 12, 4, np.float64
+    a = tu.random_hermitian_pd(m, dtype, seed=3)
+    mat = DistributedMatrix.from_global(grid_2x4, np.triu(a), (nb, nb))
+    res = hermitian_eigensolver("U", mat)
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+
+
+def test_heev_grids(comm_grids):
+    m, nb, dtype = 12, 4, np.float64
+    a = tu.random_hermitian_pd(m, dtype, seed=4)
+    for grid in comm_grids[:4]:
+        mat = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+        res = hermitian_eigensolver("L", mat)
+        check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+
+
+def test_heev_partial_spectrum(grid_2x4):
+    m, nb, dtype = 16, 4, np.float64
+    a = tu.random_hermitian_pd(m, dtype, seed=5)
+    res = hermitian_eigensolver(
+        "L", DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb)), spectrum=(0, 5)
+    )
+    evals_ref = np.linalg.eigvalsh(a)[:6]
+    np.testing.assert_allclose(res.eigenvalues, evals_ref, atol=1e-10)
+    assert tuple(res.eigenvectors.size) == (16, 6)
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_hegv(grid_2x4, dtype):
+    m, nb = 13, 4
+    a = tu.random_hermitian_pd(m, dtype, seed=6)
+    b = tu.random_hermitian_pd(m, dtype, seed=7)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, np.tril(b), (nb, nb))
+    res = hermitian_generalized_eigensolver("L", mat_a, mat_b)
+    w_ref = sla.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(res.eigenvalues, w_ref, atol=tu.tol_for(dtype, m, 2000.0))
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global(), b=b,
+              tol=tu.tol_for(dtype, m, 2000.0))
+
+
+def test_band_to_tridiag_component(grid_2x4):
+    m, nb = 12, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=8)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        band_mat, _ = reduction_to_band(mat)
+        r = band_to_tridiagonal(band_mat)
+        assert r.d.dtype == np.float64 and r.e.dtype == np.float64
+        trid = np.diag(r.d) + np.diag(r.e, 1) + np.diag(r.e, -1)
+        # q2^H B q2 = T, so eigvals(T) == eigvals(B) == eigvals(A)
+        np.testing.assert_allclose(
+            np.linalg.eigvalsh(trid), np.linalg.eigvalsh(a), atol=1e-10
+        )
+        # q2 unitary
+        np.testing.assert_allclose(
+            r.q2.conj().T @ r.q2, np.eye(m), atol=1e-12
+        )
+
+
+def test_tridiag_solver_component(grid_2x4):
+    n = 16
+    rng = np.random.default_rng(0)
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    w, v = tridiagonal_eigensolver(grid_2x4, d, e, 4)
+    trid = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    check_eig(trid, w, v.to_global())
