@@ -104,9 +104,18 @@ def cholesky_factorization(uplo: str, mat_a: DistributedMatrix) -> DistributedMa
         data = _compiled(mat_a.grid, g, uplo)(mat_a.data)
         return mat_a.like(data)
     if uplo == t.UPPER:
-        # A = U^H U with U = L^H of the conj-transposed problem: factor the
-        # Hermitian matrix itself (A^H = A), take L from the Lower path on
-        # A^T.conj == A... the Upper factor is computed natively by running
-        # the Lower kernel on the transposed stacked layout.
-        raise NotImplementedError("uplo='U' arrives with the transposed-layout pass")
+        # A = U^H U with U = L^H: mirror the stored upper triangle to lower
+        # storage, run the Lower kernel, conj-transpose the factor back
+        # (reference implements a native call_U mirror-image loop,
+        # factorization/cholesky/impl.h:316-453; the two transposes here are
+        # single all-to-alls, negligible next to the N^3/3 factorization).
+        from dlaf_tpu.matrix import util as mutil
+
+        low = mutil.transpose(mutil.extract_triangle(mat_a, "U"), conj=True)
+        fac = cholesky_factorization(t.LOWER, low)
+        u = mutil.transpose(mutil.extract_triangle(fac, "L"), conj=True)
+        # keep the caller's original lower triangle untouched (LAPACK-style)
+        return mat_a.like(
+            mutil.extract_triangle(mat_a, "L", k=-1).data + mutil.extract_triangle(u, "U").data
+        )
     raise ValueError(f"bad uplo {uplo}")

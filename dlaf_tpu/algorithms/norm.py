@@ -1,0 +1,39 @@
+"""Distributed matrix norms.
+
+TPU-native analogue of the reference auxiliary/norm
+(reference: include/dlaf/auxiliary/norm.h:36 max_norm + auxiliary/norm/mc.h:
+per-tile lange(max) then sync::reduce(MPI_MAX)).  Here: one jitted reduction
+over the local tile stack with an element mask for padding and uplo
+selection; replication over the mesh makes the global max a free psum-style
+reduce (jnp.max over the stacked array — XLA inserts the collective).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.matrix.util import _global_element_grids
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _max_norm_data(x, dist, uplo):
+    gi, gj = _global_element_grids(dist)
+    m, n = dist.size
+    keep = (gi < m) & (gj < n)
+    if uplo == "L":
+        keep &= gi >= gj
+    elif uplo == "U":
+        keep &= gi <= gj
+    vals = jnp.where(keep, jnp.abs(x), 0)
+    return jnp.max(vals) if x.size else jnp.zeros((), vals.dtype)
+
+
+def max_norm(mat: DistributedMatrix, uplo: str = "G") -> float:
+    """Max-norm (largest |a_ij|) of the matrix; ``uplo`` in {'G','L','U'}
+    restricts to a triangle (the reference's lange/lantr split)."""
+    if mat.size.count() == 0:
+        return 0.0
+    return float(_max_norm_data(mat.data, mat.dist, uplo))

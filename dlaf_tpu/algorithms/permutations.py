@@ -1,0 +1,39 @@
+"""Distributed row/column permutations.
+
+TPU-native analogue of the reference permutations
+(reference: include/dlaf/permutations/general/api.h:22-33 Permutations::call,
+impl.h + perms.cu batched device gather; distributed variant uses
+all-to-all-style p2p).  Here a permutation is a global gather expressed as
+unpack -> take -> pack inside one jit; XLA lowers the resharding to the same
+all-to-all the reference hand-codes.  Used by the (future on-device) D&C
+merge step exactly as in the reference.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlaf_tpu.matrix import layout
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _permute_data(x, perm, dist, coord):
+    g = layout.unpad_global(layout.unpack(x, dist), dist)
+    g = jnp.take(g, perm, axis=0 if coord == "rows" else 1)
+    return layout.pack(layout.pad_global(g, dist), dist)
+
+
+def permute(mat: DistributedMatrix, perm, coord: str = "rows") -> DistributedMatrix:
+    """Gather-permutation: rows -> out[i, :] = in[perm[i], :];
+    cols -> out[:, j] = in[:, perm[j]]."""
+    n = mat.size.rows if coord == "rows" else mat.size.cols
+    perm = jnp.asarray(np.asarray(perm), jnp.int32)
+    if perm.shape != (n,):
+        raise ValueError(f"perm must have shape ({n},), got {perm.shape}")
+    if coord not in ("rows", "cols"):
+        raise ValueError(f"coord must be 'rows' or 'cols', got {coord}")
+    return mat.like(_permute_data(mat.data, perm, mat.dist, coord))

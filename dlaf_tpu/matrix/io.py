@@ -1,0 +1,75 @@
+"""Matrix I/O: save/load distributed matrices.
+
+TPU-native analogue of the reference HDF5 matrix I/O
+(reference: include/dlaf/matrix/hdf5.h:94-308 FileHDF5 — per-rank hyperslab
+read/write, used by debug dumps and miniapp --input-file).  HDF5 isn't in
+this image; .npz carries the same payload (global array + distribution
+metadata).  Large-matrix sharded output writes one file per grid rank
+(the hyperslab analogue).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index import Size2D
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def save(path: str, mat: DistributedMatrix) -> None:
+    """Save a matrix (gathered) + metadata to one .npz."""
+    np.savez_compressed(
+        path,
+        data=mat.to_global(),
+        block_size=np.asarray(tuple(mat.block_size)),
+        grid_size=np.asarray(tuple(mat.dist.grid_size)),
+    )
+
+
+def load(path: str, grid: Grid, block_size=None) -> DistributedMatrix:
+    with np.load(path) as z:
+        a = z["data"]
+        bs = tuple(z["block_size"]) if block_size is None else tuple(block_size)
+    return DistributedMatrix.from_global(grid, a, Size2D(*bs))
+
+
+def save_sharded(prefix: str, mat: DistributedMatrix) -> None:
+    """One .npy per grid rank holding its local tile stack (hyperslab-style;
+    no gather)."""
+    x = np.asarray(mat.data)
+    pr, pc = mat.dist.grid_size
+    os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+    for r in range(pr):
+        for c in range(pc):
+            np.save(f"{prefix}.r{r}c{c}.npy", x[r, c])
+    np.savez(
+        f"{prefix}.meta.npz",
+        size=np.asarray(tuple(mat.size)),
+        block_size=np.asarray(tuple(mat.block_size)),
+        grid_size=np.asarray((pr, pc)),
+    )
+
+
+def load_sharded(prefix: str, grid: Grid) -> DistributedMatrix:
+    import jax
+    import jax.numpy as jnp
+
+    from dlaf_tpu.matrix.distribution import Distribution
+
+    with np.load(f"{prefix}.meta.npz") as z:
+        size = Size2D(*z["size"])
+        bs = Size2D(*z["block_size"])
+        pr, pc = z["grid_size"]
+    if (pr, pc) != tuple(grid.grid_size):
+        raise ValueError(f"file grid {(pr, pc)} != target grid {tuple(grid.grid_size)}")
+    dist = Distribution(size, bs, grid.grid_size)
+    blocks = np.stack(
+        [
+            np.stack([np.load(f"{prefix}.r{r}c{c}.npy") for c in range(pc)])
+            for r in range(pr)
+        ]
+    )
+    data = jax.device_put(jnp.asarray(blocks), grid.stacked_sharding())
+    return DistributedMatrix(dist, grid, data)

@@ -1,0 +1,43 @@
+"""Debug printers.
+
+Analogue of the reference's matrix printers
+(reference: include/dlaf/matrix/print_numpy.h, print_csv.h, print_gpu.h):
+render a distributed matrix as a numpy literal / CSV for debugging, and a
+tile-ownership map (which the reference gets from misc/matrix_distribution
+docs)."""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from dlaf_tpu.common.index import iterate_range2d
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def format_numpy(mat: DistributedMatrix, name: str = "mat") -> str:
+    """numpy-literal source text (print_numpy.h style)."""
+    a = mat.to_global()
+    return f"{name} = np.array({np.array2string(a, separator=', ', threshold=1 << 20)})"
+
+
+def format_csv(mat: DistributedMatrix) -> str:
+    a = mat.to_global()
+    buf = io.StringIO()
+    for row in a:
+        buf.write(",".join(repr(v) for v in row) + "\n")
+    return buf.getvalue()
+
+
+def format_ownership(mat: DistributedMatrix) -> str:
+    """Tile -> rank map, one line per tile row (debugging distributions)."""
+    d = mat.dist
+    nt = d.nr_tiles
+    lines = []
+    for i in range(nt.rows):
+        cells = []
+        for j in range(nt.cols):
+            r, c = d.rank_global_tile((i, j))
+            cells.append(f"({r},{c})")
+        lines.append(" ".join(cells))
+    return "\n".join(lines)

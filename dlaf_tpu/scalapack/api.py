@@ -1,0 +1,147 @@
+"""Synchronous ScaLAPACK-style API.
+
+TPU-native analogue of the reference C / ScaLAPACK drop-in surface
+(reference: include/dlaf_c/grid.h:31-77 grid registry, dlaf_c/desc.h
+DLAF_descriptor, dlaf_c/eigensolver/eigensolver.h:36-119 dlaf_p*{po,sy,he}*
+wrappers; src/c_api/*).  The reference wraps per-rank BLACS buffers into
+Matrix objects, mirrors to the device, runs the async C++ algorithm and
+waits.  Here the single-controller equivalent: numpy-in / numpy-out
+functions over a grid-context registry, blocking until the result is
+materialized.  Routine names mirror ScaLAPACK (p?potrf, p?potri, p?trtri,
+p?trsm, p?syevd/p?heevd, p?sygvd/p?hegvd, p?gemm).
+
+The ``_s/_d/_c/_z`` type suffixes of the C API collapse into dtype dispatch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index import Size2D
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+_grids: Dict[int, Grid] = {}
+_next_ctx = 2**31 - 1  # reference starts contexts at INT_MAX (grid.h:21)
+
+
+@dataclass
+class Descriptor:
+    """Blocking descriptor (reference DLAF_descriptor, dlaf_c/desc.h).
+
+    ``m, n``: global size; ``mb, nb``: block size; ``isrc, jsrc``: source
+    rank coordinates.  (``i, j, ld`` of the C struct describe the local
+    buffer window, which has no analogue in the single-controller API.)"""
+
+    m: int
+    n: int
+    mb: int
+    nb: int
+    isrc: int = 0
+    jsrc: int = 0
+
+
+def create_grid(rows: int, cols: int) -> int:
+    """Register a device grid, returning an integer context
+    (dlaf_create_grid, grid.h:31)."""
+    global _next_ctx
+    ctx = _next_ctx
+    _next_ctx -= 1
+    _grids[ctx] = Grid.create(Size2D(rows, cols))
+    return ctx
+
+
+def free_grid(ctx: int) -> None:
+    _grids.pop(ctx, None)
+
+
+def _grid(ctx: int) -> Grid:
+    if ctx not in _grids:
+        raise ValueError(f"unknown grid context {ctx}")
+    return _grids[ctx]
+
+
+def _dist(ctx: int, a: np.ndarray, desc: Descriptor) -> DistributedMatrix:
+    if a.shape != (desc.m, desc.n):
+        raise ValueError(f"array {a.shape} != descriptor {(desc.m, desc.n)}")
+    return DistributedMatrix.from_global(
+        _grid(ctx), a, (desc.mb, desc.nb), source_rank=(desc.isrc, desc.jsrc)
+    )
+
+
+def ppotrf(ctx: int, uplo: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
+    """Cholesky factorization (dlaf_pspotrf/pdpotrf/pcpotrf/pzpotrf)."""
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+
+    return cholesky_factorization(uplo, _dist(ctx, a, desc)).to_global()
+
+
+def ppotri(ctx: int, uplo: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
+    """Inverse from Cholesky factor (dlaf_p*potri)."""
+    from dlaf_tpu.algorithms.inverse import inverse_from_cholesky_factor
+
+    return inverse_from_cholesky_factor(uplo, _dist(ctx, a, desc)).to_global()
+
+
+def ptrtri(ctx: int, uplo: str, diag: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
+    from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+    return triangular_inverse(uplo, diag, _dist(ctx, a, desc)).to_global()
+
+
+def ptrsm(
+    ctx: int, side: str, uplo: str, op: str, diag: str, alpha,
+    a: np.ndarray, desc_a: Descriptor, b: np.ndarray, desc_b: Descriptor,
+) -> np.ndarray:
+    from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+
+    side_v = t.LEFT if side in ("L", t.LEFT) else t.RIGHT
+    return triangular_solver(
+        side_v, uplo, op, diag, alpha, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b)
+    ).to_global()
+
+
+def pgemm(
+    ctx: int, opa: str, opb: str, alpha, a, desc_a, b, desc_b, beta, c, desc_c
+) -> np.ndarray:
+    from dlaf_tpu.algorithms.multiplication import general_multiplication
+
+    return general_multiplication(
+        opa, opb, alpha, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b), beta, _dist(ctx, c, desc_c)
+    ).to_global()
+
+
+def pheevd(
+    ctx: int, uplo: str, a: np.ndarray, desc: Descriptor,
+    spectrum: Optional[Tuple[int, int]] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Hermitian eigensolver (dlaf_p{s,d}syevd / p{c,z}heevd, incl. the
+    partial-spectrum 'x' variants via ``spectrum``).  Returns (w, z)."""
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+
+    res = hermitian_eigensolver(uplo, _dist(ctx, a, desc), spectrum=spectrum)
+    return res.eigenvalues, res.eigenvectors.to_global()
+
+
+psyevd = pheevd  # real-symmetric alias
+
+
+def phegvd(
+    ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
+    b: np.ndarray, desc_b: Descriptor,
+    spectrum: Optional[Tuple[int, int]] = None, factorized: bool = False,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Generalized Hermitian eigensolver (dlaf_p*{sy,he}gvd[_factorized])."""
+    from dlaf_tpu.algorithms.eigensolver import hermitian_generalized_eigensolver
+
+    res = hermitian_generalized_eigensolver(
+        uplo, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b),
+        spectrum=spectrum, factorized=factorized,
+    )
+    return res.eigenvalues, res.eigenvectors.to_global()
+
+
+psygvd = phegvd  # real-symmetric alias

@@ -1,0 +1,81 @@
+"""Runtime configuration and tunable algorithm parameters.
+
+TPU-native analogue of the reference's two config families
+(reference: include/dlaf/init.h:32-55 ``configuration`` — runtime resources;
+include/dlaf/tune.h:118-165 ``TuneParameters`` — algorithm knobs) with the
+same three-layer precedence: defaults -> user values -> environment
+(``DLAF_TPU_*``), mutable between calls via the module singleton
+(reference getTuneParameters(), tune.h:168).
+
+Most reference knobs govern machinery XLA owns here (thread pools, stream
+pools, umpire pool geometry, communicator clones) and have no analogue; the
+surviving knobs control algorithm shape choices.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+
+def _env(name: str, default, cast):
+    v = os.environ.get(f"DLAF_TPU_{name.upper()}")
+    if v is None:
+        return default
+    if cast is bool:
+        return v.lower() in ("1", "true", "yes", "on")
+    return cast(v)
+
+
+@dataclass
+class TuneParameters:
+    """Algorithm knobs (reference tune.h:118-165).
+
+    - ``default_block_size``: tile size used when callers don't specify one
+      (reference block sizes come from the user's ScaLAPACK descriptor).
+      256 keeps tiles MXU-shaped (multiples of 128 preferred on TPU).
+    - ``eigensolver_min_band``: kept for interface parity; band == tile size
+      in this implementation (reference tune.h:126).
+    - ``bt_apply_group_size``: panels applied per back-transform fori_loop
+      step (reference bt_band_to_tridiag_hh_apply_group_size, tune.h:105).
+    - ``tridiag_host_solver``: 'stemr' (MRRR) or 'stedc'-style host driver
+      for the tridiagonal stage.
+    - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
+      (reference debug_dump_* flags, tune.h:30-67).
+    """
+
+    default_block_size: int = field(default_factory=lambda: _env("default_block_size", 256, int))
+    eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
+    bt_apply_group_size: int = field(default_factory=lambda: _env("bt_apply_group_size", 1, int))
+    tridiag_host_solver: str = field(default_factory=lambda: _env("tridiag_host_solver", "stemr", str))
+    debug_dump_eigensolver_data: bool = field(
+        default_factory=lambda: _env("debug_dump_eigensolver_data", False, bool)
+    )
+    debug_dump_cholesky_data: bool = field(
+        default_factory=lambda: _env("debug_dump_cholesky_data", False, bool)
+    )
+
+    def update(self, **kwargs) -> "TuneParameters":
+        for k, v in kwargs.items():
+            if k not in {f.name for f in fields(self)}:
+                raise ValueError(f"unknown tune parameter {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+_params: TuneParameters | None = None
+
+
+def get_tune_parameters() -> TuneParameters:
+    """Module singleton, mutable between algorithm calls (tune.h:168)."""
+    global _params
+    if _params is None:
+        _params = TuneParameters()
+    return _params
+
+
+def initialize(**overrides) -> TuneParameters:
+    """Reset parameters from defaults+env, then apply explicit overrides
+    (reference dlaf::initialize precedence: user cfg < env < CLI)."""
+    global _params
+    _params = TuneParameters()
+    return _params.update(**overrides)

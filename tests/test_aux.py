@@ -1,0 +1,55 @@
+"""max_norm, permutations, upper cholesky tests
+(reference: test/unit/auxiliary/test_norm.cpp, test/unit/permutations/)."""
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.norm import max_norm
+from dlaf_tpu.algorithms.permutations import permute
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def test_max_norm(comm_grids):
+    a = tu.random_matrix(13, 9, np.float64, seed=1)
+    a[7, 3] = -55.0
+    for grid in comm_grids[:3]:
+        mat = DistributedMatrix.from_global(grid, a, (4, 4))
+        assert max_norm(mat) == 55.0
+    # triangle-restricted
+    b = np.zeros((8, 8))
+    b[0, 7] = 3.0  # strictly upper
+    b[7, 0] = -2.0  # strictly lower
+    mat = DistributedMatrix.from_global(comm_grids[0], b, (4, 4))
+    assert max_norm(mat, "L") == 2.0
+    assert max_norm(mat, "U") == 3.0
+    assert max_norm(mat, "G") == 3.0
+
+
+def test_max_norm_empty(grid_2x4):
+    mat = DistributedMatrix.zeros(grid_2x4, (0, 0), (4, 4))
+    assert max_norm(mat) == 0.0
+
+
+@pytest.mark.parametrize("coord", ["rows", "cols"])
+def test_permute(grid_2x4, coord):
+    rng = np.random.default_rng(3)
+    a = tu.random_matrix(13, 13, np.float64, seed=2)
+    perm = rng.permutation(13)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+    out = permute(mat, perm, coord)
+    expected = a[perm, :] if coord == "rows" else a[:, perm]
+    np.testing.assert_array_equal(out.to_global(), expected)
+
+
+def test_cholesky_upper(grid_2x4):
+    m, mb = 13, 4
+    a = tu.random_hermitian_pd(m, np.complex128, seed=4)
+    stored = np.triu(a) + np.tril(np.ones((m, m)), -1) * 3.0  # poison lower
+    mat = DistributedMatrix.from_global(grid_2x4, stored, (mb, mb))
+    out = cholesky_factorization("U", mat)
+    u = np.linalg.cholesky(a).conj().T
+    tu.assert_near(out, u, tu.tol_for(np.complex128, m, 40.0), uplo="U")
+    # lower original values preserved
+    og = out.to_global()
+    np.testing.assert_array_equal(np.tril(og, -1), np.tril(stored, -1))

@@ -1,0 +1,85 @@
+"""ScaLAPACK-style API + IO + printers + tune tests
+(reference: test/unit/c_api/, test/unit/matrix/test_matrix_output.cpp,
+test_hdf5.cpp)."""
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.matrix import io as mio
+from dlaf_tpu.matrix import printers
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.scalapack import api as sl
+from dlaf_tpu.tune import get_tune_parameters, initialize
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = sl.create_grid(2, 4)
+    yield c
+    sl.free_grid(c)
+
+
+def test_ppotrf_ppotri(ctx):
+    m = 13
+    a = tu.random_hermitian_pd(m, np.float64, seed=1)
+    desc = sl.Descriptor(m, m, 4, 4)
+    fac = sl.ppotrf(ctx, "L", a, desc)
+    np.testing.assert_allclose(np.tril(fac), np.linalg.cholesky(a), atol=1e-10)
+    inv = sl.ppotri(ctx, "L", fac, desc)
+    np.testing.assert_allclose(inv, np.linalg.inv(a), atol=1e-8)
+
+
+def test_pheevd(ctx):
+    m = 12
+    a = tu.random_hermitian_pd(m, np.complex128, seed=2)
+    desc = sl.Descriptor(m, m, 4, 4)
+    w, z = sl.pheevd(ctx, "L", np.tril(a), desc)
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), atol=1e-10)
+    assert np.abs(a @ z - z * w[None, :]).max() < 1e-9
+
+
+def test_ptrsm_pgemm(ctx):
+    m, n = 12, 8
+    a = tu.random_triangular(m, np.float64, lower=True, seed=3)
+    b = tu.random_matrix(m, n, np.float64, seed=4)
+    da = sl.Descriptor(m, m, 4, 4)
+    db = sl.Descriptor(m, n, 4, 4)
+    x = sl.ptrsm(ctx, "L", "L", "N", "N", 1.0, a, da, b, db)
+    np.testing.assert_allclose(a @ x, b, atol=1e-10)
+    c = sl.pgemm(ctx, "N", "N", 1.0, a, da, x, db, 0.0, np.zeros((m, n)), db)
+    np.testing.assert_allclose(c, b, atol=1e-10)
+
+
+def test_io_roundtrip(tmp_path, grid_2x4):
+    a = tu.random_matrix(13, 9, np.complex128, seed=5)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+    p = str(tmp_path / "mat.npz")
+    mio.save(p, mat)
+    back = mio.load(p, grid_2x4)
+    np.testing.assert_array_equal(back.to_global(), a)
+    prefix = str(tmp_path / "shards" / "mat")
+    mio.save_sharded(prefix, mat)
+    back2 = mio.load_sharded(prefix, grid_2x4)
+    np.testing.assert_array_equal(back2.to_global(), a)
+
+
+def test_printers(grid_2x4):
+    mat = DistributedMatrix.from_element_function(grid_2x4, (4, 4), (2, 2), lambda i, j: i * 4.0 + j)
+    s = printers.format_numpy(mat, "m")
+    assert s.startswith("m = np.array(")
+    csv = printers.format_csv(mat)
+    assert len(csv.strip().splitlines()) == 4
+    own = printers.format_ownership(mat)
+    assert own.splitlines()[0].startswith("(0,0)")
+
+
+def test_tune(monkeypatch):
+    p = initialize()
+    assert p.default_block_size == 256
+    p.update(default_block_size=128)
+    assert get_tune_parameters().default_block_size == 128
+    monkeypatch.setenv("DLAF_TPU_EIGENSOLVER_MIN_BAND", "64")
+    p2 = initialize()
+    assert p2.eigensolver_min_band == 64
+    with pytest.raises(ValueError):
+        p2.update(not_a_knob=1)
