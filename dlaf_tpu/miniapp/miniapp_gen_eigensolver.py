@@ -1,0 +1,55 @@
+"""HEGV benchmark driver (reference: miniapp/miniapp_gen_eigensolver.cpp).
+
+Usage: python -m dlaf_tpu.miniapp.miniapp_gen_eigensolver --m 4096 --mb 256 \
+          --type z --grid-rows 2 --grid-cols 2 --check last
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.eigensolver import hermitian_generalized_eigensolver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.miniapp import common
+
+
+def flops(args):
+    n3 = float(args.m) ** 3
+    # chol N^3/3 + hegst N^3 + heev (10/3)N^3 + trsm backsubst N^3/2
+    add = (n3 / 3 + n3 + 10.0 / 3.0 * n3 + n3 / 2) / 2
+    return common.ops_add_mul(common.DTYPES[args.type], add, add)
+
+
+def main(argv=None):
+    args = common.miniapp_parser(__doc__).parse_args(argv)
+    grid = common.make_grid(args)
+    dtype = common.DTYPES[args.type]
+    a = tu.random_hermitian_pd(args.m, dtype, seed=1)
+    b = tu.random_hermitian_pd(args.m, dtype, seed=2)
+    mat_b_src = np.tril(b)
+
+    def make_input():
+        return DistributedMatrix.from_global(grid, np.tril(a), (args.mb, args.mb))
+
+    box = {}
+
+    def run(mat_a):
+        mat_b = DistributedMatrix.from_global(grid, mat_b_src, (args.mb, args.mb))
+        res = hermitian_generalized_eigensolver("L", mat_a, mat_b)
+        box["res"] = res
+        return res.eigenvectors
+
+    def check(out):
+        res = box["res"]
+        v = out.to_global()
+        w = res.eigenvalues
+        rel = np.abs(a @ v - b @ v * w[None, :]).max() / max(np.abs(a).max(), 1)
+        bortho = np.abs(v.conj().T @ b @ v - np.eye(v.shape[1])).max()
+        assert rel < tu.tol_for(dtype, args.m, 5000.0), rel
+        assert bortho < tu.tol_for(dtype, args.m, 5000.0), bortho
+
+    return common.run_timed(args, make_input, run, check, flops, name="gen_eigensolver")
+
+
+if __name__ == "__main__":
+    main()

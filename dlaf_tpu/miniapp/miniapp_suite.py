@@ -1,0 +1,132 @@
+"""Remaining per-algorithm benchmark drivers, one subcommand each
+(reference: miniapp/miniapp_{triangular_multiplication,gen_to_std,
+reduction_to_band,band_to_tridiag,tridiag_solver,inverse,norm,
+permutations}.cpp — compacted into a single driver module here).
+
+Usage: python -m dlaf_tpu.miniapp.miniapp_suite <name> [miniapp options]
+where <name> in {trmm, hemm, gen_to_std, red2band, band2trid, tridiag,
+trtri, potri, norm, permute, bt_red2band}.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.miniapp import common
+from dlaf_tpu.ops import tile as t
+
+
+def _n3(args):
+    return float(args.m) ** 3
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 1
+    name = argv.pop(0)
+    p = common.miniapp_parser(__doc__)
+    args = p.parse_args(argv)
+    grid = common.make_grid(args)
+    dtype = common.DTYPES[args.type]
+    m, mb = args.m, args.mb
+
+    herm = tu.random_hermitian_pd(m, dtype, seed=1)
+    tri = tu.random_triangular(m, dtype, lower=True, seed=2)
+    dense = tu.random_matrix(m, m, dtype, seed=3)
+
+    def dm(a):
+        return lambda: DistributedMatrix.from_global(grid, a, (mb, mb))
+
+    if name == "trmm":
+        from dlaf_tpu.algorithms.multiplication import triangular_multiplication
+
+        mat_a = dm(tri)()
+        run = lambda b: triangular_multiplication(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, b)
+        make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a) / 2, _n3(a) / 2)
+    elif name == "hemm":
+        from dlaf_tpu.algorithms.multiplication import hermitian_multiplication
+
+        mat_a = dm(np.tril(herm))()
+        zero = dm(np.zeros((m, m), dtype))()
+        run = lambda b: hermitian_multiplication(t.LEFT, t.LOWER, 1.0, mat_a, b, 0.0, zero)
+        make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a), _n3(a))
+    elif name == "gen_to_std":
+        from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+
+        mat_b = dm(np.linalg.cholesky(tu.random_hermitian_pd(m, dtype, seed=4)))()
+        run = lambda a: generalized_to_standard("L", a, mat_b)
+        make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, _n3(a) / 2, _n3(a) / 2)
+    elif name == "red2band":
+        from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+        run = lambda a: reduction_to_band(a)[0]
+        make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, 2 * _n3(a) / 3, 2 * _n3(a) / 3)
+    elif name == "band2trid":
+        from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
+        from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+        band, _ = reduction_to_band(dm(np.tril(herm))())
+
+        class _W:  # adapt: run returns a DistributedMatrix for timing sync
+            pass
+
+        def run(a):
+            band_to_tridiagonal(band)
+            return band
+
+        make, fl = (lambda: band), None
+    elif name == "tridiag":
+        from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
+
+        rng = np.random.default_rng(0)
+        d_, e_ = rng.standard_normal(m), rng.standard_normal(m - 1)
+
+        def run(a):
+            _, v = tridiagonal_eigensolver(grid, d_, e_, mb, dtype=dtype)
+            return v
+
+        make, fl = dm(np.zeros((m, m), dtype)), None
+    elif name == "trtri":
+        from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+        run = lambda a: triangular_inverse("L", "N", a)
+        make, fl = dm(tri), lambda a: common.ops_add_mul(dtype, _n3(a) / 6, _n3(a) / 6)
+    elif name == "potri":
+        from dlaf_tpu.algorithms.inverse import inverse_from_cholesky_factor
+
+        run = lambda a: inverse_from_cholesky_factor("L", a)
+        make, fl = dm(np.linalg.cholesky(herm)), lambda a: common.ops_add_mul(dtype, _n3(a) / 3, _n3(a) / 3)
+    elif name == "bt_red2band":
+        from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
+        from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+        band, taus = reduction_to_band(dm(np.tril(herm))())
+        run = lambda e: bt_reduction_to_band(e, band, taus)
+        make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a), _n3(a))
+    elif name == "norm":
+        from dlaf_tpu.algorithms.norm import max_norm
+
+        def run(a):
+            max_norm(a)
+            return a
+
+        make, fl = dm(dense), None
+    elif name == "permute":
+        from dlaf_tpu.algorithms.permutations import permute
+
+        perm = np.random.default_rng(1).permutation(m)
+        run = lambda a: permute(a, perm, "rows")
+        make, fl = dm(dense), None
+    else:
+        print(f"unknown miniapp {name!r}; see module docstring")
+        return 1
+    return common.run_timed(args, make, run, None, fl, name=name)
+
+
+if __name__ == "__main__":
+    sys.exit(main() and 0)

@@ -1,0 +1,51 @@
+"""TRSM benchmark driver (reference: miniapp/miniapp_triangular_solver.cpp).
+
+Usage: python -m dlaf_tpu.miniapp.miniapp_triangular_solver --m 16384 --n 16384 \
+          --mb 256 --grid-rows 2 --grid-cols 2 --check last
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg as sla
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.miniapp import common
+from dlaf_tpu.ops import tile as t
+
+
+def flops(args):
+    add = args.m * args.m * args.n / 2
+    return common.ops_add_mul(common.DTYPES[args.type], add, add)
+
+
+def main(argv=None):
+    p = common.miniapp_parser(__doc__)
+    p.add_argument("--n", type=int, default=None)
+    args = p.parse_args(argv)
+    if args.n is None:
+        args.n = args.m
+    grid = common.make_grid(args)
+    dtype = common.DTYPES[args.type]
+    a = tu.random_triangular(args.m, dtype, lower=True, seed=1)
+    b = tu.random_matrix(args.m, args.n, dtype, seed=2)
+
+    def make_input():
+        return DistributedMatrix.from_global(grid, b, (args.mb, args.mb))
+
+    mat_a = DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
+
+    def run(mat_b):
+        return triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
+
+    def check(out):
+        x = out.to_global()
+        r = np.abs(a @ x - b).max() / max(np.abs(b).max(), 1)
+        assert r < tu.tol_for(dtype, args.m, 500.0), r
+
+    return common.run_timed(args, make_input, run, check, flops, name="triangular_solver")
+
+
+if __name__ == "__main__":
+    main()
