@@ -65,6 +65,29 @@ def hermitian_eigensolver(
     return EigResult(evals, e)
 
 
+def hermitian_eigenvalues(
+    uplo: str,
+    mat_a: DistributedMatrix,
+    spectrum: Optional[Tuple[int, int]] = None,
+) -> np.ndarray:
+    """Eigenvalues only (LAPACK jobz='N' analogue): skips all back-transforms
+    and the N x N band-stage Q — the band reduction runs the native C++
+    bulge-chasing kernel (O(N^2 b))."""
+    import scipy.linalg as sla
+
+    if uplo == t.UPPER:
+        mat_a = mutil.extract_triangle(mutil.hermitize(mat_a, "U"), "L")
+    band_mat, _ = reduction_to_band(mat_a)
+    b2t = band_to_tridiagonal(band_mat, want_q=False)
+    if b2t.d.shape[0] == 0:
+        return b2t.d
+    if spectrum is None:
+        return sla.eigh_tridiagonal(b2t.d, b2t.e, eigvals_only=True)
+    return sla.eigh_tridiagonal(
+        b2t.d, b2t.e, eigvals_only=True, select="i", select_range=spectrum
+    )
+
+
 def hermitian_generalized_eigensolver(
     uplo: str,
     mat_a: DistributedMatrix,

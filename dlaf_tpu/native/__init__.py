@@ -1,0 +1,111 @@
+"""Native C++ host-runtime components.
+
+The reference is a C++ library end to end; in the TPU re-design, XLA owns
+the device path and C++ keeps the host stages that the reference itself runs
+on CPU — currently the bulge-chasing band->tridiagonal kernel
+(band2trid.cpp, analogue of eigensolver/band_to_tridiag/mc.h).
+
+The shared library is built on first import with g++ (no pybind11 in the
+image — plain extern "C" + ctypes).  Everything degrades gracefully to the
+scipy host path if the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_dlaf_native.so")
+_SRC = os.path.join(_HERE, "band2trid.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO, _SRC, "-lpthread",
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        import ctypes as ct
+
+        i64, ip = ct.c_int64, ct.POINTER
+        for name, scalar in [
+            ("dlaf_band2trid_d", ct.c_double),
+            ("dlaf_band2trid_s", ct.c_float),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = ct.c_int
+            fn.argtypes = [i64, i64, ip(scalar), ip(scalar), ip(scalar), ct.c_void_p, ct.c_int]
+        for name, rsc in [("dlaf_band2trid_z", ct.c_double), ("dlaf_band2trid_c", ct.c_float)]:
+            fn = getattr(lib, name)
+            fn.restype = ct.c_int
+            fn.argtypes = [i64, i64, ct.c_void_p, ip(rsc), ct.c_void_p, ct.c_void_p, ct.c_int]
+        _lib = lib
+        return _lib
+
+
+def band2trid_native(ab, band: int, want_q: bool = True, nthreads: int = 0):
+    """Reduce a Hermitian band matrix to tridiagonal with the C++ kernel.
+
+    ``ab``: (band+2, n) lower-banded storage, column j holds A[j:j+band+2, j]
+    (row band+1 is scratch for the bulge and must be zero on entry).
+    Returns (d, e, q) with q None when ``want_q`` is False, or None if the
+    native library is unavailable."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    ab = np.asfortranarray(ab)
+    dt = ab.dtype
+    n = ab.shape[1]
+    if nthreads <= 0:
+        nthreads = min(os.cpu_count() or 1, 16)
+    names = {
+        np.dtype(np.float64): ("dlaf_band2trid_d", np.float64),
+        np.dtype(np.float32): ("dlaf_band2trid_s", np.float32),
+        np.dtype(np.complex128): ("dlaf_band2trid_z", np.float64),
+        np.dtype(np.complex64): ("dlaf_band2trid_c", np.float32),
+    }
+    if dt not in names:
+        return None
+    fname, rdt = names[dt]
+    d = np.zeros(n, rdt)
+    e = np.zeros(max(n - 1, 0), dt)
+    q = np.zeros((n, n), dt) if want_q else None
+    fn = getattr(lib, fname)
+    c = ctypes
+    ptr = lambda a: a.ctypes.data_as(c.c_void_p) if a is not None else None
+    if dt.kind == "c":
+        rc = fn(n, band, ptr(ab), d.ctypes.data_as(c.POINTER(c.c_double if rdt == np.float64 else c.c_float)), ptr(e), ptr(q), nthreads)
+    else:
+        tp = c.POINTER(c.c_double if dt == np.float64 else c.c_float)
+        rc = fn(n, band, ab.ctypes.data_as(tp), d.ctypes.data_as(tp), e.ctypes.data_as(tp), ptr(q), nthreads)
+    if rc != 0:
+        return None
+    return d, e, q

@@ -1,0 +1,323 @@
+// Band -> tridiagonal reduction via Givens bulge chasing (Schwarz/Rutishauser),
+// with threaded accumulation of the unitary transformation Q.
+//
+// Native host-stage analogue of the reference band_to_tridiag
+// (reference: include/dlaf/eigensolver/band_to_tridiag/mc.h — BandBlock +
+// SweepWorker bulge chasing, CPU-only there as well, api.h:40-46).  The
+// reduction itself touches only the band: O(N^2 * b) flops.  Accumulating Q
+// explicitly is O(N^3) but embarrassingly parallel over row stripes; the
+// rotation stream is buffered in chunks so worker threads replay it over
+// their own stripe without per-rotation synchronization.
+//
+// Storage: lower band, column-major with leading dimension (b+2) — one
+// extra sub-band row for the transient bulge:
+//   ab[i + j*(b+2)] = A[j+i, j],  0 <= i <= b+1.
+// Q is n x n row-major; rotations update adjacent column pairs (cache-local).
+//
+// Exposed as extern "C" for ctypes (no pybind11 in this image).
+
+#include <cmath>
+#include <complex>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+template <class T>
+struct Real {
+  using type = T;
+};
+template <class T>
+struct Real<std::complex<T>> {
+  using type = T;
+};
+
+template <class T>
+using real_t = typename Real<T>::type;
+
+template <class T>
+inline real_t<T> abs2(T x) {
+  return std::norm(x);
+}
+inline double abs2(double x) { return x * x; }
+inline float abs2(float x) { return x * x; }
+
+template <class T>
+inline T conj_(T x) {
+  return x;
+}
+template <class T>
+inline std::complex<T> conj_(std::complex<T> x) {
+  return std::conj(x);
+}
+
+// Givens rotation zeroing `g` against pivot `f`:
+//   [ c        s ] [f]   [r]
+//   [-conj(s)  c ] [g] = [0],  c real >= 0, |c|^2 + |s|^2 = 1.
+template <class T>
+inline void make_givens(T f, T g, real_t<T>& c, T& s, T& r) {
+  using R = real_t<T>;
+  R af2 = abs2(f), ag2 = abs2(g);
+  if (ag2 == R(0)) {
+    c = R(1);
+    s = T(0);
+    r = f;
+    return;
+  }
+  R d = std::sqrt(af2 + ag2);
+  if (af2 == R(0)) {
+    c = R(0);
+    s = conj_(g) / d * T(1);  // s = conj(g)/|g| scaled
+    // r = s * g ... with f = 0: r = conj(g)/d * g = |g|^2/d = d
+    r = T(d);
+    return;
+  }
+  // scale by phase of f so r keeps f's phase
+  c = std::sqrt(af2) / d;
+  T fs = f / T(std::sqrt(af2));
+  s = fs * conj_(g) / T(d);
+  r = fs * T(d);
+}
+
+struct RotRec {
+  int64_t col;  // left column index p (pair is (p, p+1))
+  double c;
+  double s_re;
+  double s_im;
+};
+
+// Apply buffered rotations to Q stripe rows [r0, r1): Q := Q * G^H for each,
+// i.e. for G = [[c, s], [-conj(s), c]] acting on coords (p, p+1):
+//   Q[:, p]   =  c*Q[:,p] - conj(s)*Q[:,p+1]  ... derive: (Q G^H) columns:
+//   G^H = [[c, -s], [conj(s), c]]
+//   newQ[:,p]   = c*Q[:,p] + conj(s)*Q[:,p+1]
+//   newQ[:,p+1] = -s*Q[:,p] + c*Q[:,p+1]
+template <class T>
+void apply_chunk(T* q, int64_t n, int64_t r0, int64_t r1,
+                 const std::vector<RotRec>& rots) {
+  for (const auto& rec : rots) {
+    const int64_t p = rec.col;
+    T s;
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      s = T(typename T::value_type(rec.s_re), typename T::value_type(rec.s_im));
+    } else {
+      s = T(rec.s_re);
+    }
+    const real_t<T> c = real_t<T>(rec.c);
+    for (int64_t i = r0; i < r1; ++i) {
+      T* row = q + i * n;
+      T a = row[p], b = row[p + 1];
+      row[p] = c * a + conj_(s) * b;
+      row[p + 1] = -s * a + c * b;
+    }
+  }
+}
+
+template <class T>
+class QAccumulator {
+ public:
+  QAccumulator(T* q, int64_t n, int nthreads)
+      : q_(q), n_(n), nthreads_(q ? std::max(1, nthreads) : 0) {
+    if (q_) {
+      std::memset(static_cast<void*>(q_), 0, sizeof(T) * n_ * n_);
+      for (int64_t i = 0; i < n_; ++i) q_[i * n_ + i] = T(1);
+      buf_.reserve(kChunk);
+    }
+  }
+
+  void push(int64_t p, real_t<T> c, T s) {
+    if (!q_) return;
+    double sre, sim;
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      sre = double(s.real());
+      sim = double(s.imag());
+    } else {
+      sre = double(s);
+      sim = 0.0;
+    }
+    buf_.push_back(RotRec{p, double(c), sre, sim});
+    if (buf_.size() >= kChunk) flush();
+  }
+
+  void flush() {
+    if (!q_ || buf_.empty()) return;
+    if (nthreads_ == 1) {
+      apply_chunk(q_, n_, 0, n_, buf_);
+    } else {
+      std::vector<std::thread> ws;
+      int64_t step = (n_ + nthreads_ - 1) / nthreads_;
+      for (int t = 0; t < nthreads_; ++t) {
+        int64_t r0 = t * step, r1 = std::min(n_, r0 + step);
+        if (r0 >= r1) break;
+        ws.emplace_back([this, r0, r1] { apply_chunk(q_, n_, r0, r1, buf_); });
+      }
+      for (auto& w : ws) w.join();
+    }
+    buf_.clear();
+  }
+
+ private:
+  static constexpr size_t kChunk = 1 << 21;  // ~2M rotations per replay
+  T* q_;
+  int64_t n_;
+  int nthreads_;
+  std::vector<RotRec> buf_;
+};
+
+// Rotate the Hermitian band for the coordinate pair (p, p+1):
+// A := G A G^H with G as above.  Band accessor: lower storage, the bulge row
+// is i == b+1.
+template <class T>
+struct Band {
+  T* ab;
+  int64_t n;
+  int64_t b;    // bandwidth (sub-diagonals)
+  int64_t ld;   // b + 2
+
+  inline T get(int64_t i, int64_t j) const {  // i >= j, i - j <= b+1
+    return ab[(i - j) + j * ld];
+  }
+  inline void set(int64_t i, int64_t j, T v) { ab[(i - j) + j * ld] = v; }
+
+  // A(i,j) for any order, reading the lower triangle
+  inline T full(int64_t i, int64_t j) const {
+    if (i >= j) return get(i, j);
+    return conj_(get(j, i));
+  }
+  inline void full_set(int64_t i, int64_t j, T v) {
+    if (i >= j)
+      set(i, j, v);
+    else
+      set(j, i, conj_(v));
+  }
+};
+
+template <class T>
+void rotate_band(Band<T>& A, int64_t p, real_t<T> c, T s) {
+  const int64_t n = A.n, b = A.b;
+  const int64_t q = p + 1;
+  // affected region: rows/cols max(0, p-b-1) .. min(n-1, q+b+1), but only
+  // entries within band+bulge of (p, q)
+  const int64_t lo = std::max<int64_t>(0, p - (b + 1));
+  const int64_t hi = std::min<int64_t>(n - 1, q + (b + 1));
+  // 1) rows p,q for columns k < p (within band)
+  for (int64_t k = lo; k < p; ++k) {
+    if (p - k > b + 1) continue;
+    T ap = (p - k <= b + 1) ? A.get(p, k) : T(0);
+    T aq = (q - k <= b + 1) ? A.get(q, k) : T(0);
+    T np_ = c * ap + s * aq;
+    T nq = -conj_(s) * ap + c * aq;
+    if (p - k <= b + 1) A.set(p, k, np_);
+    if (q - k <= b + 1) A.set(q, k, nq);
+  }
+  // 2) columns p,q for rows k > q (within band)
+  for (int64_t k = q + 1; k <= hi; ++k) {
+    if (k - p > b + 1) continue;
+    T ap = (k - p <= b + 1) ? A.get(k, p) : T(0);
+    T aq = (k - q <= b + 1) ? A.get(k, q) : T(0);
+    // right-multiplication by G^H on columns: new col p gets conj coefs
+    T np_ = c * ap + conj_(s) * aq;
+    T nq = -s * ap + c * aq;
+    if (k - p <= b + 1) A.set(k, p, np_);
+    if (k - q <= b + 1) A.set(k, q, nq);
+  }
+  // 3) the 2x2 diagonal block (p,p),(q,p),(q,q)
+  T app = A.get(p, p), aqp = A.get(q, p), aqq = A.get(q, q);
+  // B = G * [app conj(aqp); aqp aqq] * G^H
+  T t_pp = c * app + s * aqp;
+  T t_pq = c * conj_(aqp) + s * aqq;
+  T t_qp = -conj_(s) * app + c * aqp;
+  T t_qq = -conj_(s) * conj_(aqp) + c * aqq;
+  T n_pp = t_pp * c + t_pq * conj_(s);
+  T n_qp = t_qp * c + t_qq * conj_(s);
+  T n_qq = -(t_qp * s) + t_qq * c;
+  A.set(p, p, n_pp);
+  A.set(q, p, n_qp);
+  A.set(q, q, n_qq);
+}
+
+template <class T>
+int band2trid(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, T* q,
+              int nthreads) {
+  if (n <= 0) return 0;
+  Band<T> A{ab, n, b, b + 2};
+  QAccumulator<T> acc(q, n, nthreads);
+  if (b > 1) {
+    for (int64_t j = 0; j + 2 < n; ++j) {
+      const int64_t rmax = std::min(j + b, n - 1);
+      for (int64_t r = rmax; r >= j + 2; --r) {
+        if (abs2(A.get(r, j)) == real_t<T>(0)) continue;
+        // annihilate A(r, j) with rows (r-1, r); rotate_band applies the
+        // rotation to column j too, then we pin the annihilated entry to 0
+        real_t<T> c;
+        T s, rr;
+        make_givens(A.get(r - 1, j), A.get(r, j), c, s, rr);
+        rotate_band(A, r - 1, c, s);
+        A.set(r, j, T(0));
+        acc.push(r - 1, c, s);
+        // chase the bulge created at (r-1 + b + 1, r - 1 - ... ):
+        // after rotating pair (r-1, r), fill appears at A(r+b, r-1)
+        int64_t i = r;
+        while (i + b < n) {
+          const int64_t br = i + b;      // bulge row
+          const int64_t bc = i - 1;      // bulge col
+          if (abs2(A.get(br, bc)) == real_t<T>(0)) break;
+          real_t<T> c2;
+          T s2, r2;
+          make_givens(A.get(br - 1, bc), A.get(br, bc), c2, s2, r2);
+          rotate_band(A, br - 1, c2, s2);
+          A.set(br, bc, T(0));
+          acc.push(br - 1, c2, s2);
+          i += b;
+        }
+      }
+    }
+  }
+  acc.flush();
+  for (int64_t j = 0; j < n; ++j) {
+    // diagonal of a Hermitian matrix is real
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      d[j] = A.get(j, j).real();
+    } else {
+      d[j] = A.get(j, j);
+    }
+    if (j + 1 < n) e[j] = A.get(j + 1, j);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dlaf_band2trid_d(int64_t n, int64_t b, double* ab, double* d, double* e,
+                     double* q, int nthreads) {
+  return band2trid<double>(n, b, ab, d, e, q, nthreads);
+}
+
+int dlaf_band2trid_s(int64_t n, int64_t b, float* ab, float* d, float* e,
+                     float* q, int nthreads) {
+  return band2trid<float>(n, b, ab, d, e, q, nthreads);
+}
+
+int dlaf_band2trid_z(int64_t n, int64_t b, void* ab, double* d, void* e,
+                     void* q, int nthreads) {
+  return band2trid<std::complex<double>>(
+      n, b, reinterpret_cast<std::complex<double>*>(ab), d,
+      reinterpret_cast<std::complex<double>*>(e),
+      reinterpret_cast<std::complex<double>*>(q), nthreads);
+}
+
+int dlaf_band2trid_c(int64_t n, int64_t b, void* ab, float* d, void* e,
+                     void* q, int nthreads) {
+  return band2trid<std::complex<float>>(
+      n, b, reinterpret_cast<std::complex<float>*>(ab), d,
+      reinterpret_cast<std::complex<float>*>(e),
+      reinterpret_cast<std::complex<float>*>(q), nthreads);
+}
+}

@@ -112,3 +112,36 @@ def test_tridiag_solver_component(grid_2x4):
     w, v = tridiagonal_eigensolver(grid_2x4, d, e, 4)
     trid = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
     check_eig(trid, w, v.to_global())
+
+
+def test_hermitian_eigenvalues_only(grid_2x4):
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigenvalues
+
+    m, nb = 16, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=11)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        w = hermitian_eigenvalues("L", mat)
+        np.testing.assert_allclose(w, np.linalg.eigvalsh(a), atol=1e-10)
+        w2 = hermitian_eigenvalues("L", mat, spectrum=(0, 3))
+        np.testing.assert_allclose(w2, np.linalg.eigvalsh(a)[:4], atol=1e-10)
+
+
+def test_band_to_tridiag_native_backend(grid_2x4):
+    from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
+
+    m, nb = 16, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=12)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        band_mat, _ = reduction_to_band(mat)
+        r_nat = band_to_tridiagonal(band_mat, backend="native")
+        r_lap = band_to_tridiagonal(band_mat, backend="lapack")
+        trid_n = np.diag(r_nat.d) + np.diag(r_nat.e, 1) + np.diag(r_nat.e, -1)
+        trid_l = np.diag(r_lap.d) + np.diag(r_lap.e, 1) + np.diag(r_lap.e, -1)
+        np.testing.assert_allclose(
+            np.linalg.eigvalsh(trid_n), np.linalg.eigvalsh(trid_l), atol=1e-10
+        )
+        np.testing.assert_allclose(
+            r_nat.q2.conj().T @ r_nat.q2, np.eye(m), atol=1e-12
+        )
