@@ -76,13 +76,75 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
     return coll.relocal(x)
 
 
+def _chol_segments(mt: int):
+    """Halving segments [k0, k1) so each runs with a static trailing-window
+    bucket: ~log2(mt) segments, per-segment waste <= 2x."""
+    segs = []
+    k0 = 0
+    while k0 < mt:
+        k1 = min(mt, k0 + max(1, (mt - k0 + 1) // 2))
+        segs.append((k0, k1))
+        k0 = k1
+    return segs
+
+
+def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
+    """Bucketed variant of _chol_L_kernel: the trailing update runs on a
+    dynamic-sliced window of the local tile stack whose STATIC size shrinks
+    by segment — restoring the reference's 'only the trailing submatrix'
+    flop count (impl.h:273-300) within static-shape constraints.  Windows
+    are over-approximate and clamped; masked panels make overlap rows/cols
+    no-ops, so clamping is always safe."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+
+    def step(k, x, L, C):
+        kr, kc = k % g.pr, k % g.pc
+        lkr, lkc = k // g.pr, k // g.pc
+        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        lkk = t.potrf(d, lower=True)
+        # local window starts (first slot with gi >= k+1 / gj >= k+1)
+        rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
+        cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
+        gi_w = (rs + jnp.arange(L)) * g.pr + myr
+        jv = (cs + jnp.arange(C)) * g.pc + myc
+        # panel trsm on the row window only
+        xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+        below = (gi_w > k)[:, None, None]
+        cp = coll.psum_axis(
+            jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan)), COL_AXIS
+        )
+        rp = coll.transpose_panel_windowed(cp, jv, rs, g.mt)
+        # write the factored panel (window rows) and the diagonal tile
+        new_col = jnp.where(below & (myc == kc), pan, xc)
+        x = lax.dynamic_update_slice(x, new_col[:, None], (rs, lkc, 0, 0))
+        mine_d = (myr == kr) & (myc == kc)
+        dtile = jnp.where(mine_d, lkk, x[lkr, lkc])[None, None]
+        x = lax.dynamic_update_slice(x, dtile.astype(x.dtype), (lkr, lkc, 0, 0))
+        # trailing update on the window
+        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+        xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+        return lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+
+    for k0, k1 in _chol_segments(g.mt):
+        L = min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1)
+        C = min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1)
+        L, C = max(L, 1), max(C, 1)
+        x = lax.fori_loop(k0, k1, partial(step, L=L, C=C), x)
+
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
 _kernel_cache = {}
 
 
-def _compiled(grid, g: _spmd.Geometry, uplo: str):
-    key = (id(grid.mesh), g, uplo)
+def _compiled(grid, g: _spmd.Geometry, uplo: str, bucketed: bool = True):
+    key = (id(grid.mesh), g, uplo, bucketed)
     if key not in _kernel_cache:
-        kern = partial(_chol_L_kernel, g=g)
+        kern = partial(_chol_L_bucketed_kernel if bucketed else _chol_L_kernel, g=g)
         _kernel_cache[key] = coll.spmd(grid, kern, donate_argnums=(0,))
     return _kernel_cache[key]
 

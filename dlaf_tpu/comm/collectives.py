@@ -106,6 +106,24 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     return lax.psum(contrib, ROW_AXIS)
 
 
+def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
+    """Windowed variant of :func:`transpose_panel` for bucketed trailing
+    updates: ``cp[L, ...]`` holds panel tiles for this rank's local row slots
+    ``rs .. rs+L-1`` (global tiles ``(rs+i)*Pr + myr``); returns
+    ``rp[C, ...]`` with ``rp[c] = panel tile of global index jv[c]`` (zero
+    where out of range).  ``rs`` may differ per rank row (each contributor
+    uses its own window offset)."""
+    myr, _ = my_rank()
+    pr, _ = grid_shape()
+    L = cp.shape[0]
+    C = jv.shape[0]
+    src_slot = jv // pr - rs
+    have = (jv % pr == myr) & (jv < nr_row_tiles) & (src_slot >= 0) & (src_slot < L)
+    taken = jnp.take(cp, jnp.clip(src_slot, 0, L - 1), axis=0)
+    contrib = jnp.where(have.reshape((C,) + (1,) * (cp.ndim - 1)), taken, 0)
+    return lax.psum(contrib, ROW_AXIS)
+
+
 def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
     """Row panel -> column panel redistribution (inverse of
     :func:`transpose_panel`).
