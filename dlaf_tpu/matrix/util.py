@@ -102,6 +102,56 @@ def _set_diag_data(x, dist: Distribution, alpha, beta, overwrite_all: bool):
     return jnp.where(diag, jnp.full_like(x, beta), x)
 
 
+def retile(mat: DistributedMatrix, new_block_size) -> DistributedMatrix:
+    """Re-tile to a different block size (reference:
+    Matrix::retiledSubPipeline, matrix/matrix.h:560-618 — there an in-place
+    tile sub-split; here a relayout through the global form, one all-to-all
+    under jit)."""
+    from functools import partial as _p
+
+    import jax as _jax
+
+    from dlaf_tpu.matrix import layout
+    from dlaf_tpu.matrix.distribution import Distribution as _D
+
+    new_dist = _D(mat.size, new_block_size, mat.dist.grid_size, mat.dist.source_rank)
+
+    @_p(_jax.jit, static_argnums=(1, 2))
+    def _relayout(x, d_old, d_new):
+        g = layout.unpad_global(layout.unpack(x, d_old), d_old)
+        return layout.pack(layout.pad_global(g, d_new), d_new)
+
+    data = _relayout(mat.data, mat.dist, new_dist)
+    data = _jax.device_put(data, mat.grid.stacked_sharding())
+    return DistributedMatrix(new_dist, mat.grid, data)
+
+
+def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
+    """Tile-aligned sub-matrix copy (reference: MatrixRef sub-matrix view,
+    matrix/matrix_ref.h:39; functional copy instead of aliasing view)."""
+    from functools import partial as _p
+
+    import jax as _jax
+
+    from dlaf_tpu.matrix import layout
+
+    sub_dist = mat.dist.sub_distribution(origin, size)
+    # normalize to source_rank 0 storage for downstream algorithms
+    from dlaf_tpu.matrix.distribution import Distribution as _D
+
+    out_dist = _D(sub_dist.size, sub_dist.block_size, sub_dist.grid_size)
+
+    @_p(_jax.jit, static_argnums=(1, 2, 3), static_argnames=())
+    def _slice(x, d_old, d_new, org):
+        g = layout.unpad_global(layout.unpack(x, d_old), d_old)
+        s = g[org[0] : org[0] + d_new.size.rows, org[1] : org[1] + d_new.size.cols]
+        return layout.pack(layout.pad_global(s, d_new), d_new)
+
+    data = _slice(mat.data, mat.dist, out_dist, tuple(origin))
+    data = _jax.device_put(data, mat.grid.stacked_sharding())
+    return DistributedMatrix(out_dist, mat.grid, data)
+
+
 def laset(mat: DistributedMatrix, alpha, beta) -> DistributedMatrix:
     """Set all elements to alpha, diagonal to beta (lapack laset analogue)."""
     return mat.like(_set_diag_data(mat.data, mat.dist, alpha, beta, True))

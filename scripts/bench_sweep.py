@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Strong/weak scaling sweep driver (reference: scripts/gen_dlaf_strong-gpu.py
+job generators + plot_*.py, compacted: one script that sweeps grid shapes /
+sizes on the available devices and emits a CSV for plot_scaling.py)."""
+import argparse
+import csv
+import itertools
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--algo", default="cholesky", choices=["cholesky", "trsm", "red2band"])
+    p.add_argument("--sizes", default="2048,4096,8192")
+    p.add_argument("--mb", type=int, default=256)
+    p.add_argument("--type", choices="sdcz", default="s")
+    p.add_argument("--grids", default="1x1", help="comma list, e.g. 1x1,2x2,2x4")
+    p.add_argument("--out", default="scaling.csv")
+    args = p.parse_args()
+
+    import jax
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index import Size2D
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+    from dlaf_tpu.miniapp.common import DTYPES, ops_add_mul, sync
+    from dlaf_tpu.ops import tile as t
+
+    dtype = DTYPES[args.type]
+    if np.dtype(dtype).itemsize == 8:
+        jax.config.update("jax_enable_x64", True)
+    rows = []
+    for gs, n in itertools.product(args.grids.split(","), args.sizes.split(",")):
+        pr, pc = (int(v) for v in gs.split("x"))
+        n = int(n)
+        if pr * pc > len(jax.devices()):
+            continue
+        grid = Grid.create(Size2D(pr, pc))
+        a = tu.random_hermitian_pd(n, dtype, seed=1)
+        if args.algo == "cholesky":
+            from dlaf_tpu.algorithms.cholesky import cholesky_factorization as run_algo
+
+            run = lambda m: run_algo("L", m)
+            fl = ops_add_mul(dtype, n**3 / 6, n**3 / 6)
+        elif args.algo == "trsm":
+            from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+
+            mat_a = DistributedMatrix.from_global(grid, np.tril(a) + n * np.eye(n, dtype=np.dtype(dtype)), (args.mb, args.mb))
+            run = lambda m: triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, m)
+            fl = ops_add_mul(dtype, n**3 / 2, n**3 / 2)
+        else:
+            from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+            run = lambda m: reduction_to_band(m)[0]
+            fl = ops_add_mul(dtype, 2 * n**3 / 3, 2 * n**3 / 3)
+        best = None
+        for i in range(3):
+            mat = DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
+            sync(mat.data)
+            t0 = time.perf_counter()
+            out = run(mat)
+            sync(out.data)
+            dt = time.perf_counter() - t0
+            if i:
+                best = dt if best is None else min(best, dt)
+        gflops = fl / best / 1e9
+        print(f"{args.algo} n={n} grid={gs}: {best:.4f}s {gflops:.1f} GFlop/s")
+        rows.append({"algo": args.algo, "n": n, "grid": gs, "time_s": best, "gflops": gflops})
+    with open(args.out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        w.writeheader()
+        w.writerows(rows)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

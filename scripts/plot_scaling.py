@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Plot scaling CSVs from bench_sweep.py (reference: scripts/plot_*.py).
+Falls back to an ASCII table when matplotlib is unavailable."""
+import csv
+import sys
+
+
+def main(path="scaling.csv"):
+    with open(path) as f:
+        rows = list(csv.DictReader(f))
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        for grid in sorted({r["grid"] for r in rows}):
+            pts = [(int(r["n"]), float(r["gflops"])) for r in rows if r["grid"] == grid]
+            ax.plot(*zip(*sorted(pts)), marker="o", label=grid)
+        ax.set_xlabel("N")
+        ax.set_ylabel("GFlop/s")
+        ax.set_xscale("log", base=2)
+        ax.legend(title="grid")
+        out = path.replace(".csv", ".png")
+        fig.savefig(out, dpi=150)
+        print(f"wrote {out}")
+    except ImportError:
+        for r in rows:
+            print(f"{r['algo']:10s} n={r['n']:>7s} grid={r['grid']:>5s} {float(r['gflops']):10.1f} GF/s")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -90,3 +90,24 @@ def test_shape_validation(grid_2x4):
     d_bad = Distribution((8, 8), (4, 4), (3, 3))
     with pytest.raises(ValueError):
         DistributedMatrix(d_bad, grid_2x4, jnp.zeros((3, 3, 1, 1, 4, 4)))
+
+
+def test_retile(grid_2x4):
+    from dlaf_tpu.matrix.util import retile
+
+    a = np.random.default_rng(0).standard_normal((13, 9))
+    m = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+    m2 = retile(m, (3, 5))
+    assert tuple(m2.block_size) == (3, 5)
+    np.testing.assert_array_equal(m2.to_global(), a)
+
+
+def test_sub_matrix(grid_2x4):
+    from dlaf_tpu.matrix.util import sub_matrix
+
+    a = np.random.default_rng(1).standard_normal((16, 16))
+    m = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+    s = sub_matrix(m, (4, 8), (8, 8))
+    np.testing.assert_array_equal(s.to_global(), a[4:12, 8:16])
+    with pytest.raises(ValueError):
+        sub_matrix(m, (3, 0), (4, 4))
