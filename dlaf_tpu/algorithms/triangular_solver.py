@@ -124,6 +124,86 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
     return coll.relocal(b)
 
 
+def _halving_segments(n: int):
+    segs = []
+    s0 = 0
+    while s0 < n:
+        s1 = min(n, s0 + max(1, (n - s0 + 1) // 2))
+        segs.append((s0, s1))
+        s0 = s1
+    return segs
+
+
+def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
+    """Bucketed variant of _trsm_left_kernel: the remaining-rows window of B
+    (and the A panel) is dynamic-sliced with a static per-segment size, like
+    cholesky's bucketed trailing update.  Masked panels make clamped window
+    overlap a no-op."""
+    a = coll.local(a)
+    b = coll.local(b)
+    myr, myc = coll.my_rank()
+    a = _spmd.pad_diag_identity(a, g_a, myr, myc)
+    lower = uplo == t.LOWER
+    forward = lower == (op == t.NO_TRANS)
+    mt = g_a.mt
+    b = (jnp.asarray(alpha, b.dtype) * b).astype(b.dtype)
+
+    def step(s, b, L):
+        k = s if forward else mt - 1 - s
+        kr, kc = k % g_a.pr, k % g_a.pc
+        lkr = k // g_a.pr
+        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+        brow = _spmd.take_row(b, lkr, g_b)
+        solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
+        xr = coll.psum_axis(
+            jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
+        )
+        b = _spmd.put_row(b, jnp.where(myr == kr, solved, brow), lkr)
+        # remaining-rows window
+        if forward:
+            rs = jnp.clip((k + g_a.pr - myr) // g_a.pr, 0, max(g_b.ltr - L, 0))
+            rs = rs.astype(jnp.asarray(k).dtype)
+        else:
+            rs = jnp.asarray(k) * 0  # start at 0, only the size shrinks
+        gi_w = (rs + jnp.arange(L)) * g_a.pr + myr
+        remaining = (gi_w > k) if forward else (gi_w < k)
+        if op == t.NO_TRANS:
+            ac = lax.dynamic_slice(
+                a, (rs, k // g_a.pc, 0, 0), (L, 1, g_a.mb, g_a.mb)
+            )[:, 0]
+            cp = coll.psum_axis(
+                jnp.where((myc == kc) & remaining[:, None, None], ac, jnp.zeros_like(ac)),
+                COL_AXIS,
+            )
+        else:
+            ar = _spmd.take_row(a, lkr, g_a)
+            gj = _spmd.local_col_tiles(g_a, myc)
+            rem_j = (gj > k) if forward else (gj < k)
+            rp = coll.psum_axis(
+                jnp.where((myr == kr) & rem_j[:, None, None], ar, jnp.zeros_like(ar)),
+                ROW_AXIS,
+            )
+            # row panel -> windowed col panel: tiles indexed by A's col j
+            iv = gi_w
+            pc = g_a.pc
+            src_slot = jnp.clip(iv // pc, 0, g_a.ltc - 1)
+            have = (iv % pc == myc) & (iv < g_a.mt)
+            contrib = jnp.where(
+                have[:, None, None], jnp.take(rp, src_slot, axis=0), 0
+            )
+            cp = t.op_tile(coll.psum_axis(contrib, COL_AXIS), op)
+            cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
+        bs = lax.dynamic_slice(b, (rs, 0, 0, 0), (L, g_b.ltc, g_b.mb, g_b.nb))
+        bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
+        return lax.dynamic_update_slice(b, bs, (rs, 0, 0, 0))
+
+    for s0, s1 in _halving_segments(mt):
+        rem = mt - 1 - s0  # max remaining tiles within the segment
+        L = max(min(g_b.ltr, (rem + g_a.pr - 1) // g_a.pr + 1), 1)
+        b = lax.fori_loop(s0, s1, partial(step, L=L), b)
+    return coll.relocal(b)
+
+
 _cache = {}
 
 
@@ -149,7 +229,7 @@ def triangular_solver(
     g_b = _spmd.Geometry.of(mat_b.dist)
     if g_b.mt == 0 or g_b.nt == 0 or g_a.mt == 0:
         return mat_b
-    kern_fn = _trsm_left_kernel if side == t.LEFT else _trsm_right_kernel
+    kern_fn = _trsm_left_bucketed_kernel if side == t.LEFT else _trsm_right_kernel
     key = (id(mat_b.grid.mesh), side, uplo, op, diag, complex(alpha), g_a, g_b)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
