@@ -132,3 +132,15 @@ def test_validation():
         Distribution((4, 4), (4, 4), (2, 2), (2, 0))
     with pytest.raises(ValueError):
         Distribution((-1, 4), (4, 4))
+
+
+def test_import_all_modules():
+    """Header self-containment analogue (reference test/header/): every
+    module imports standalone."""
+    import importlib
+    import pkgutil
+
+    import dlaf_tpu
+
+    for mod in pkgutil.walk_packages(dlaf_tpu.__path__, "dlaf_tpu."):
+        importlib.import_module(mod.name)
