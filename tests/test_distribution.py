@@ -143,4 +143,6 @@ def test_import_all_modules():
     import dlaf_tpu
 
     for mod in pkgutil.walk_packages(dlaf_tpu.__path__, "dlaf_tpu."):
+        if mod.name.endswith("_dlaf_native"):
+            continue  # plain ctypes .so, not a CPython extension module
         importlib.import_module(mod.name)
