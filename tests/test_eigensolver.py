@@ -145,3 +145,17 @@ def test_band_to_tridiag_native_backend(grid_2x4):
         np.testing.assert_allclose(
             r_nat.q2.conj().T @ r_nat.q2, np.eye(m), atol=1e-12
         )
+
+
+def test_heev_single_device_backend(grid_1x1):
+    m, nb = 24, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=13)
+        mat = DistributedMatrix.from_global(grid_1x1, np.tril(a), (nb, nb))
+        res = hermitian_eigensolver("L", mat)  # auto -> XLA eigh path
+        check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+        res2 = hermitian_eigensolver("L", mat, spectrum=(2, 7))
+        np.testing.assert_allclose(res2.eigenvalues, np.linalg.eigvalsh(a)[2:8], atol=1e-10)
+        assert tuple(res2.eigenvectors.size) == (m, 6)
+        res3 = hermitian_eigensolver("L", mat, backend="pipeline")
+        check_eig(a, res3.eigenvalues, res3.eigenvectors.to_global())
