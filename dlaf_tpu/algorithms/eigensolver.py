@@ -74,6 +74,9 @@ def hermitian_eigensolver(
     return EigResult(evals, e)
 
 
+_eigh_cache = {}
+
+
 def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
     """Single-device fast path: XLA eigh on the hermitized dense matrix."""
     import jax
@@ -82,15 +85,18 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
     from dlaf_tpu.matrix import layout
 
     dist = mat_a.dist
+    key = (dist, np.dtype(mat_a.dtype))
+    if key not in _eigh_cache:
 
-    @jax.jit
-    def run(x):
-        g = layout.unpad_global(layout.unpack(x, dist), dist)
-        full = jnp.tril(g) + jnp.swapaxes(jnp.tril(g, -1), -1, -2).conj()
-        w, v = jnp.linalg.eigh(full)
-        return w, layout.pack(layout.pad_global(v, dist), dist)
+        @jax.jit
+        def run(x):
+            g = layout.unpad_global(layout.unpack(x, dist), dist)
+            full = jnp.tril(g) + jnp.swapaxes(jnp.tril(g, -1), -1, -2).conj()
+            w, v = jnp.linalg.eigh(full)
+            return w, layout.pack(layout.pad_global(v, dist), dist)
 
-    w, vdata = run(mat_a.data)
+        _eigh_cache[key] = run
+    w, vdata = _eigh_cache[key](mat_a.data)
     evecs = mat_a.like(jax.device_put(vdata, mat_a.grid.stacked_sharding()))
     w_host = np.asarray(w)
     if spectrum is not None:
