@@ -17,6 +17,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dlaf_tpu.algorithms import _spmd
@@ -207,13 +208,41 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
 _cache = {}
 
 
+_local_cache = {}
+
+
+def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
+    """1x1-grid fast path: one XLA triangular_solve on the dense operands
+    (~1.4x the SPMD loop on one chip at N=8k)."""
+    import jax
+
+    from dlaf_tpu.matrix import layout
+
+    da, db = mat_a.dist, mat_b.dist
+    key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha))
+    if key not in _local_cache:
+
+        @jax.jit
+        def run(xa, xb):
+            ga = layout.unpad_global(layout.unpack(xa, da), da)
+            gb = layout.unpad_global(layout.unpack(xb, db), db)
+            out = t.trsm(side, uplo, op, diag, jnp.asarray(alpha, gb.dtype), ga, gb)
+            return layout.pack(layout.pad_global(out, db), db)
+
+        _local_cache[key] = run
+    return mat_b.like(_local_cache[key](mat_a.data, mat_b.data))
+
+
 def triangular_solver(
-    side: str, uplo: str, op: str, diag: str, alpha, mat_a: DistributedMatrix, mat_b: DistributedMatrix
+    side: str, uplo: str, op: str, diag: str, alpha, mat_a: DistributedMatrix,
+    mat_b: DistributedMatrix, backend: str = "auto"
 ) -> DistributedMatrix:
     """B := solution X of op(A) X = alpha B (Left) / X op(A) = alpha B (Right).
 
     A is triangular (only the ``uplo`` triangle is read).  Returns the
-    updated B matrix (functional in-place).
+    updated B matrix (functional in-place).  ``backend='auto'`` uses one
+    dense XLA triangular_solve on 1x1 grids, the distributed SPMD kernel
+    otherwise; 'distributed' forces the kernel.
     """
     if mat_a.size.rows != mat_a.size.cols:
         raise ValueError("trsm: A must be square")
@@ -229,6 +258,8 @@ def triangular_solver(
     g_b = _spmd.Geometry.of(mat_b.dist)
     if g_b.mt == 0 or g_b.nt == 0 or g_a.mt == 0:
         return mat_b
+    if backend == "auto" and mat_b.grid.grid_size.count() == 1:
+        return _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b)
     kern_fn = _trsm_left_bucketed_kernel if side == t.LEFT else _trsm_right_kernel
     key = (id(mat_b.grid.mesh), side, uplo, op, diag, complex(alpha), g_a, g_b)
     if key not in _cache:
