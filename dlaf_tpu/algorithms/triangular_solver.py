@@ -259,7 +259,14 @@ def triangular_solver(
     if g_b.mt == 0 or g_b.nt == 0 or g_a.mt == 0:
         return mat_b
     if backend == "auto" and mat_b.grid.grid_size.count() == 1:
-        return _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b)
+        fail_key = ("fail", mat_b.size, np.dtype(mat_b.dtype))
+        if fail_key not in _local_cache:
+            try:
+                return _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b)
+            except Exception:
+                # e.g. backend compiler limits on very large dense solves —
+                # remember and use the tiled SPMD kernel instead
+                _local_cache[fail_key] = True
     kern_fn = _trsm_left_bucketed_kernel if side == t.LEFT else _trsm_right_kernel
     key = (id(mat_b.grid.mesh), side, uplo, op, diag, complex(alpha), g_a, g_b)
     if key not in _cache:
