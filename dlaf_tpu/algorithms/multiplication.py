@@ -28,6 +28,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from dlaf_tpu.algorithms import _spmd
@@ -155,6 +156,50 @@ def _summa_kernel(
 
 
 _cache = {}
+_local_cache = {}
+
+
+def _dense_structured_a(ga, structure, diag):
+    """Materialize the structured operand on a 1x1 grid (dense fast path)."""
+    if structure == _FULL:
+        return ga
+    if structure in (_LOWER_TRI, _UPPER_TRI):
+        tri = jnp.tril(ga) if structure == _LOWER_TRI else jnp.triu(ga)
+        if diag == t.UNIT:
+            eye = jnp.eye(tri.shape[-1], dtype=tri.dtype)
+            tri = tri - tri * eye + eye
+        return tri
+    lower = structure == _HERM_LOWER
+    if lower:
+        return jnp.tril(ga) + jnp.swapaxes(jnp.tril(ga, -1), -1, -2).conj()
+    return jnp.triu(ga) + jnp.swapaxes(jnp.triu(ga, 1), -1, -2).conj()
+
+
+def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, a_right):
+    """1x1-grid fast path: one dense GEMM instead of the SUMMA loop."""
+    import jax
+
+    da, db, dc = mat_a.dist, mat_b.dist, mat_c.dist
+    key = (
+        "local", da, db, dc, np.dtype(mat_c.dtype), opa, opb,
+        complex(alpha), complex(beta), structure, diag, a_right,
+    )
+    if key not in _local_cache:
+        from dlaf_tpu.matrix import layout
+
+        @jax.jit
+        def run(xa, xb, xc):
+            ga = layout.unpad_global(layout.unpack(xa, da), da)
+            gb = layout.unpad_global(layout.unpack(xb, db), db)
+            gc = layout.unpad_global(layout.unpack(xc, dc), dc)
+            ga = t.op_tile(_dense_structured_a(ga, structure, diag), opa)
+            gb = t.op_tile(gb, opb)
+            prod = (gb @ ga) if a_right else (ga @ gb)
+            out = jnp.asarray(alpha, gc.dtype) * prod + jnp.asarray(beta, gc.dtype) * gc
+            return layout.pack(layout.pad_global(out.astype(gc.dtype), dc), dc)
+
+        _local_cache[key] = run
+    return mat_c.like(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
@@ -163,6 +208,8 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
     g_c = _spmd.Geometry.of(mat_c.dist)
     if g_c.mt == 0 or g_c.nt == 0:
         return mat_c
+    if mat_c.grid.grid_size.count() == 1:
+        return _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, False)
     key = (
         id(mat_c.grid.mesh), opa, opb, complex(alpha), complex(beta), structure,
         diag, kt, g_a, g_b, g_c,
@@ -289,6 +336,8 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
     g_c = _spmd.Geometry.of(mat_c.dist)
     if g_c.mt == 0 or g_c.nt == 0:
         return mat_c
+    if mat_c.grid.grid_size.count() == 1:
+        return _run_dense_local(mat_a, mat_b, mat_c, opa, t.NO_TRANS, alpha, beta, structure, diag, True)
     kt = g_b.nt
     key = (
         "right", id(mat_c.grid.mesh), opa, complex(alpha), complex(beta),
