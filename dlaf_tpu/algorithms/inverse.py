@@ -111,6 +111,33 @@ def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
 
 
 _cache = {}
+_local_cache = {}
+
+
+def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
+    """1x1-grid fast path: dense triangular solve against the identity."""
+    import jax
+
+    from dlaf_tpu.matrix import layout
+
+    dist = mat_a.dist
+    key = (dist, str(mat_a.dtype), uplo, diag)
+    if key not in _local_cache:
+
+        @jax.jit
+        def run(x):
+            g_ = layout.unpad_global(layout.unpack(x, dist), dist)
+            eye = jnp.eye(g_.shape[0], dtype=g_.dtype)
+            inv = t.trsm(t.LEFT, uplo, t.NO_TRANS, diag, 1.0, g_, eye)
+            # keep the unreferenced triangle as the caller stored it
+            if uplo == t.LOWER:
+                out = jnp.tril(inv) + jnp.triu(g_, 1)
+            else:
+                out = jnp.triu(inv) + jnp.tril(g_, -1)
+            return layout.pack(layout.pad_global(out, dist), dist)
+
+        _local_cache[key] = run
+    return mat_a.like(_local_cache[key](mat_a.data))
 
 
 def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
@@ -121,6 +148,8 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
     g = _spmd.Geometry.of(mat_a.dist)
     if g.mt == 0:
         return mat_a
+    if mat_a.grid.grid_size.count() == 1:
+        return _trtri_single_device(uplo, diag, mat_a)
     key = (id(mat_a.grid.mesh), uplo, diag, g)
     if key not in _cache:
         kern_fn = _trtri_lower_kernel if uplo == t.LOWER else _trtri_upper_kernel

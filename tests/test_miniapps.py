@@ -1,0 +1,45 @@
+"""Miniapp integration tests: run every driver with tiny sizes + --check
+(mirrors reference CI: miniapps at 6 ranks with --check=last,
+miniapp/CMakeLists.txt:43-55)."""
+import pytest
+
+from dlaf_tpu.miniapp import (
+    miniapp_cholesky,
+    miniapp_eigensolver,
+    miniapp_gen_eigensolver,
+    miniapp_suite,
+    miniapp_triangular_solver,
+)
+
+ARGS = ["--m", "48", "--mb", "8", "--grid-rows", "2", "--grid-cols", "4",
+        "--nruns", "1", "--nwarmups", "0", "--type", "d"]
+
+
+def test_miniapp_cholesky():
+    res = miniapp_cholesky.main(ARGS + ["--check", "last"])
+    assert len(res) == 1
+
+
+def test_miniapp_trsm():
+    res = miniapp_triangular_solver.main(ARGS + ["--check", "last"])
+    assert len(res) == 1
+
+
+def test_miniapp_eigensolver():
+    res = miniapp_eigensolver.main(ARGS + ["--check", "last"])
+    assert len(res) == 1
+
+
+def test_miniapp_gen_eigensolver():
+    res = miniapp_gen_eigensolver.main(ARGS + ["--check", "last"])
+    assert len(res) == 1
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["trmm", "hemm", "gen_to_std", "red2band", "band2trid", "tridiag",
+     "trtri", "potri", "bt_red2band", "norm", "permute"],
+)
+def test_miniapp_suite(name):
+    res = miniapp_suite.main([name] + ARGS)
+    assert res and len(res) == 1
