@@ -106,18 +106,18 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
     x = coll.local(x)
     myr, myc = coll.my_rank()
     gi = _spmd.local_row_tiles(g, myr)
-    gj = _spmd.local_col_tiles(g, myc)
     np_ = g.ltr * g.pr * g.mb  # padded global rows
+    mt_pad = np_ // g.mb
     taus_all = jnp.zeros((n_panels, g.nb), x.dtype)
 
-    def body(k, carry):
+    def body(k, carry, L, C):
         x, taus_all = carry
         kc = k % g.pc
         lkc = k // g.pc
-        # 1. gather panel column to every rank
+        # 1. gather panel column to every rank (full height: O(N nb) data)
         xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
         gat = coll.all_gather_axis(xc, ROW_AXIS)  # [pr, ltr, mb, nb]
-        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(np_ // g.mb, g.mb, g.nb)
+        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, g.nb)
         col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
         p = col_tiles.reshape(np_, g.nb)
         start = (k + 1) * g.mb
@@ -125,31 +125,42 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
         taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (k, 0))
         # 2. T factor (replicated)
         tmat = _t_factor(v, taus, g.nb)
-        # 3. two-sided trailing update
-        v_tiles = v.reshape(np_ // g.mb, g.mb, g.nb)
-        vr = jnp.take(v_tiles, gi, axis=0)  # [ltr, mb, nb] local rows (in range)
-        # local col slots may be pure padding (gj >= mt_pad): clip + zero
-        valid_c = (gj < v_tiles.shape[0])[:, None, None]
+        # 3. two-sided trailing update on the bucketed window (static L x C):
+        # V is zero outside the trailing region, so clamped window overlap
+        # contributes nothing — same safety argument as cholesky bucketing
+        v_tiles = v.reshape(mt_pad, g.mb, g.nb)
+        rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(
+            jnp.asarray(k).dtype
+        )
+        cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(
+            jnp.asarray(k).dtype
+        )
+        gi_w = (rs + jnp.arange(L)) * g.pr + myr
+        gj_w = (cs + jnp.arange(C)) * g.pc + myc
+        vr = jnp.take(v_tiles, gi_w, axis=0)  # [L, mb, nb] (gi_w < mt_pad)
+        valid_c = (gj_w < mt_pad)[:, None, None]
         vc = jnp.where(
-            valid_c, jnp.take(v_tiles, jnp.clip(gj, 0, v_tiles.shape[0] - 1), axis=0), 0
-        )  # [ltc, mb, nb] local cols
-        xpart = jnp.einsum("ijab,jbc->iac", x, vc)
-        xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) local rows
+            valid_c, jnp.take(v_tiles, jnp.clip(gj_w, 0, mt_pad - 1), axis=0), 0
+        )  # [C, mb, nb]
+        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+        xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
+        xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
         xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
         mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
         mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
         w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
         # mask W2 to the trailing region (element rows >= (k+1)*mb)
-        ge = gi[:, None] * g.mb + jnp.arange(g.mb)[None, :]
+        ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
         w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
-        w2c = coll.transpose_panel(w2, g.mt, g.ltc)
-        x = (
-            x
+        w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
+        xs = (
+            xs
             - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
             - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
         )
+        x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
         # 4. write the factored panel column back (tiles below the diagonal)
-        p_tiles = p_out.reshape(np_ // g.mb, g.mb, g.nb)
+        p_tiles = p_out.reshape(mt_pad, g.mb, g.nb)
         newcol = jnp.take(p_tiles, gi, axis=0)
         below = (gi > k)[:, None, None]
         xc_now = _spmd.take_col(x, lkc, g)
@@ -157,7 +168,14 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
         x = _spmd.put_col(x, newcol, lkc)
         return x, taus_all
 
-    x, taus_all = lax.fori_loop(0, n_panels, body, (x, taus_all))
+    from dlaf_tpu.algorithms.cholesky import _chol_segments
+
+    carry = (x, taus_all)
+    for k0, k1 in _chol_segments(n_panels):
+        L = max(min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1), 1)
+        C = max(min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1), 1)
+        carry = lax.fori_loop(k0, k1, partial(body, L=L, C=C), carry)
+    x, taus_all = carry
     return coll.relocal(x), coll.relocal(taus_all)
 
 
