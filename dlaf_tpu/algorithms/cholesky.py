@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from functools import partial
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -38,6 +39,19 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.ops import tile as t
+
+
+def _diag_potrf(d):
+    """Diagonal-tile Cholesky: Pallas VMEM kernel for real dtypes (~5x the
+    XLA blocked path in-graph on TPU), XLA fallback otherwise."""
+    try:
+        from dlaf_tpu.ops import pallas_potrf
+
+        if pallas_potrf.supported(d) and jax.default_backend() == "tpu":
+            return pallas_potrf.potrf_tile(d)
+    except Exception:
+        pass
+    return t.potrf(d, lower=True)
 
 
 def _chol_L_kernel(x, g: _spmd.Geometry):
@@ -52,7 +66,7 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
         lkc = k // g.pc
         # 1. diagonal tile to everyone; redundant local potrf
         d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        lkk = t.potrf(d, lower=True)
+        lkk = _diag_potrf(d)
         # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
         xc = _spmd.take_col(x, lkc, g)
         pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
@@ -104,7 +118,7 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
         d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        lkk = t.potrf(d, lower=True)
+        lkk = _diag_potrf(d)
         # local window starts (first slot with gi >= k+1 / gj >= k+1)
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
