@@ -49,6 +49,22 @@ def tridiagonal_eigensolver(
         w = np.zeros(0, np.dtype(dtype))
         mat = DistributedMatrix.zeros(grid, (0, 0), (block_size, block_size), dtype)
         return w, mat
+    if backend == "dc_dist":
+        from dlaf_tpu.algorithms.tridiag_dc import tridiag_dc_distributed
+
+        w, mat = tridiag_dc_distributed(grid, d, e, block_size, dtype=dtype)
+        if spectrum is not None:
+            il, iu = spectrum
+            w = w[il : iu + 1]
+            mat = DistributedMatrix.from_global(
+                grid, mat.to_global()[:, il : iu + 1].astype(np.dtype(dtype)), (block_size, block_size)
+            )
+            return w, mat
+        if np.dtype(dtype).kind == "c":
+            mat = DistributedMatrix.from_global(
+                grid, mat.to_global().astype(np.dtype(dtype)), (block_size, block_size)
+            )
+        return w, mat
     if backend == "dc":
         from dlaf_tpu.algorithms.tridiag_dc import tridiag_dc
 

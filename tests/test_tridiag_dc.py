@@ -88,3 +88,16 @@ def test_tridiag_solver_dc_backend(grid_2x4):
     w2, v2 = tridiagonal_eigensolver(grid_2x4, d, e, 8, backend="dc", spectrum=(0, 5))
     np.testing.assert_allclose(w2, np.linalg.eigvalsh(t)[:6], atol=1e-11)
     assert tuple(v2.size) == (n, 6)
+
+
+def test_dc_distributed(grid_2x4):
+    rng = np.random.default_rng(7)
+    for n in [40, 100]:
+        d = rng.standard_normal(n)
+        e = rng.standard_normal(n - 1)
+        w, v = tridiagonal_eigensolver(grid_2x4, d, e, 8, backend="dc_dist")
+        t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+        vg = v.to_global()
+        assert np.abs(np.sort(w) - np.linalg.eigvalsh(t)).max() < 1e-12
+        assert np.abs(t @ vg - vg * w[None, :]).max() < 1e-9
+        assert np.abs(vg.T @ vg - np.eye(n)).max() < 1e-12
