@@ -139,6 +139,42 @@ def band_to_tridiagonal(
     return _normalize_phases(d, e_raw, q, dt)
 
 
+def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = None):
+    """Native-kernel variant that retains the compact rotation stream instead
+    of materializing Q (the reference's compact-reflector strategy).  Returns
+    (d, e, phases, stream) — apply the band-stage back-transform to a real
+    tridiagonal-eigenvector block E via ``stream.apply(E * nothing) ...``:
+
+        E_band = stream.apply(phases[:, None] * E)
+
+    (phases fold the complex subdiagonal normalization).  Returns None when
+    the native library or dtype support is unavailable."""
+    from dlaf_tpu.native import band2trid_stream
+
+    if band is None:
+        band = mat_band.block_size.rows
+    dt = np.dtype(mat_band.dtype)
+    if dt not in (np.dtype(np.float64), np.dtype(np.complex128)):
+        return None
+    m = mat_band.size.rows
+    if m == 0:
+        return None
+    ab = extract_band_storage(mat_band, band)
+    out = band2trid_stream(ab, band)
+    if out is None:
+        return None
+    d, e_raw, stream = out
+    norm = _normalize_phases(d, e_raw, None, dt)
+    if dt.kind == "c":
+        phases = np.ones(m, dtype=dt)
+        for j in range(m - 1):
+            ph = e_raw[j] / np.abs(e_raw[j]) if np.abs(e_raw[j]) > 0 else 1.0
+            phases[j + 1] = phases[j] * ph
+    else:
+        phases = np.ones(m, dtype=dt)
+    return norm.d, norm.e, phases, stream
+
+
 def _normalize_phases(d, e_raw, q, dt) -> BandToTridiagResult:
     """Roll subdiagonal phases into Q columns so (d, e) is real:
     (Q D)^H A (Q D) = real tridiag with D = diag of accumulated phases."""

@@ -65,8 +65,97 @@ def get_lib():
             fn = getattr(lib, name)
             fn.restype = ct.c_int
             fn.argtypes = [i64, i64, ct.c_void_p, ip(rsc), ct.c_void_p, ct.c_void_p, ct.c_int]
+        for name in ("dlaf_band2trid_stream_d", "dlaf_band2trid_stream_z"):
+            fn = getattr(lib, name)
+            fn.restype = ct.c_void_p
+            fn.argtypes = [i64, i64, ct.c_void_p, ip(ct.c_double), ct.c_void_p]
+        lib.dlaf_stream_size.restype = i64
+        lib.dlaf_stream_size.argtypes = [ct.c_void_p]
+        for name in ("dlaf_stream_apply_d", "dlaf_stream_apply_z"):
+            fn = getattr(lib, name)
+            fn.restype = ct.c_int
+            fn.argtypes = [ct.c_void_p, ct.c_void_p, i64, i64, ct.c_int]
+        lib.dlaf_stream_free.restype = None
+        lib.dlaf_stream_free.argtypes = [ct.c_void_p]
         _lib = lib
         return _lib
+
+
+class RotationStream:
+    """Retained Givens stream of a band->tridiagonal reduction: ``apply(ev)``
+    computes Q @ ev in place-on-a-copy for an (n, k) block — the compact
+    back-transform (no N x N Q materialized)."""
+
+    def __init__(self, handle, n, dtype, lib):
+        self._h = handle
+        self.n = n
+        self.dtype = dtype
+        self._lib = lib
+
+    def __len__(self):
+        return int(self._lib.dlaf_stream_size(self._h))
+
+    def apply(self, ev, nthreads: int = 0):
+        import numpy as np
+
+        ev = np.ascontiguousarray(ev, dtype=self.dtype).copy()
+        if ev.shape[0] != self.n:
+            raise ValueError(f"ev rows {ev.shape[0]} != n {self.n}")
+        if nthreads <= 0:
+            nthreads = min(os.cpu_count() or 1, 16)
+        fn = (
+            self._lib.dlaf_stream_apply_z
+            if np.dtype(self.dtype).kind == "c"
+            else self._lib.dlaf_stream_apply_d
+        )
+        rc = fn(self._h, ev.ctypes.data_as(ctypes.c_void_p), self.n, ev.shape[1], nthreads)
+        if rc != 0:
+            raise RuntimeError("stream apply failed")
+        return ev
+
+    def close(self):
+        if self._h is not None:
+            self._lib.dlaf_stream_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def band2trid_stream(ab, band: int):
+    """Reduce to tridiagonal retaining the rotation stream.  Returns
+    (d, e, RotationStream) or None if the native library is unavailable.
+    f64/c128 only (the stream math is kept in double)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    ab = np.asfortranarray(ab)
+    dt = ab.dtype
+    if dt not in (np.dtype(np.float64), np.dtype(np.complex128)):
+        return None
+    n = ab.shape[1]
+    d = np.zeros(n, np.float64)
+    e = np.zeros(max(n - 1, 0), dt)
+    if dt.kind == "c":
+        h = lib.dlaf_band2trid_stream_z(
+            n, band, ab.ctypes.data_as(ctypes.c_void_p),
+            d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            e.ctypes.data_as(ctypes.c_void_p),
+        )
+    else:
+        h = lib.dlaf_band2trid_stream_d(
+            n, band, ab.ctypes.data_as(ctypes.c_void_p),
+            d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            e.ctypes.data_as(ctypes.c_void_p),
+        )
+    if not h:
+        return None
+    return d, e, RotationStream(h, n, dt, lib)
 
 
 def band2trid_native(ab, band: int, want_q: bool = True, nthreads: int = 0):

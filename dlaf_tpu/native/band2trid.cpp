@@ -240,31 +240,71 @@ void rotate_band(Band<T>& A, int64_t p, real_t<T> c, T s) {
   A.set(q, q, n_qq);
 }
 
+// forward declaration; definition below shares the reduction loop between
+// the Q-accumulating and stream-recording variants
+template <class T, class Acc>
+int band2trid_acc(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, Acc& acc);
+
 template <class T>
 int band2trid(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, T* q,
               int nthreads) {
+  QAccumulator<T> acc(q, n, nthreads);
+  return band2trid_acc<T>(n, b, ab, d, e, acc);
+}
+
+// ---- rotation-stream variant -----------------------------------------------
+// Reduce once, retain the Givens stream, then apply Q = G_1^H G_2^H ... to an
+// arbitrary n x k eigenvector block later (removes the N x N Q and makes
+// partial-spectrum back-transforms cost O(R * k) — the reference's
+// compact-transformation strategy, bt_band_to_tridiag/impl.h).
+
+struct RotStream {
+  std::vector<RotRec> rots;
+};
+
+template <class T>
+class StreamRecorder {
+ public:
+  explicit StreamRecorder(RotStream* s) : s_(s) {}
+  void push(int64_t p, real_t<T> c, T s) {
+    double sre, sim;
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      sre = double(s.real());
+      sim = double(s.imag());
+    } else {
+      sre = double(s);
+      sim = 0.0;
+    }
+    s_->rots.push_back(RotRec{p, double(c), sre, sim});
+  }
+  void flush() {}
+
+ private:
+  RotStream* s_;
+};
+
+template <class T, class Acc>
+int band2trid_acc(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, Acc& acc) {
+  // shared reduction loop: annihilate column tails, chase bulges; Acc
+  // either accumulates Q or records the rotation stream
   if (n <= 0) return 0;
   Band<T> A{ab, n, b, b + 2};
-  QAccumulator<T> acc(q, n, nthreads);
   if (b > 1) {
     for (int64_t j = 0; j + 2 < n; ++j) {
       const int64_t rmax = std::min(j + b, n - 1);
       for (int64_t r = rmax; r >= j + 2; --r) {
         if (abs2(A.get(r, j)) == real_t<T>(0)) continue;
-        // annihilate A(r, j) with rows (r-1, r); rotate_band applies the
-        // rotation to column j too, then we pin the annihilated entry to 0
         real_t<T> c;
         T s, rr;
         make_givens(A.get(r - 1, j), A.get(r, j), c, s, rr);
         rotate_band(A, r - 1, c, s);
         A.set(r, j, T(0));
         acc.push(r - 1, c, s);
-        // chase the bulge created at (r-1 + b + 1, r - 1 - ... ):
-        // after rotating pair (r-1, r), fill appears at A(r+b, r-1)
         int64_t i = r;
         while (i + b < n) {
-          const int64_t br = i + b;      // bulge row
-          const int64_t bc = i - 1;      // bulge col
+          const int64_t br = i + b;
+          const int64_t bc = i - 1;
           if (abs2(A.get(br, bc)) == real_t<T>(0)) break;
           real_t<T> c2;
           T s2, r2;
@@ -279,7 +319,6 @@ int band2trid(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, T* q,
   }
   acc.flush();
   for (int64_t j = 0; j < n; ++j) {
-    // diagonal of a Hermitian matrix is real
     if constexpr (std::is_same_v<T, std::complex<double>> ||
                   std::is_same_v<T, std::complex<float>>) {
       d[j] = A.get(j, j).real();
@@ -291,9 +330,91 @@ int band2trid(int64_t n, int64_t b, T* ab, real_t<T>* d, T* e, T* q,
   return 0;
 }
 
+// Apply Q (= G_1^H G_2^H ... G_R^H, i.e. the stream in REVERSE with G^H) to
+// rows of the n x k row-major block E: E := Q E.  Threads stripe columns.
+template <class T>
+void apply_stream_rows(const RotStream& s, T* ev, int64_t n, int64_t k,
+                       int64_t c0, int64_t c1) {
+  for (auto it = s.rots.rbegin(); it != s.rots.rend(); ++it) {
+    const int64_t p = it->col;
+    T sv;
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      sv = T(typename T::value_type(it->s_re), typename T::value_type(it->s_im));
+    } else {
+      sv = T(it->s_re);
+    }
+    const real_t<T> c = real_t<T>(it->c);
+    T* rp = ev + p * k;
+    T* rq = ev + (p + 1) * k;
+    for (int64_t j = c0; j < c1; ++j) {
+      T a = rp[j], bv = rq[j];
+      rp[j] = c * a - sv * bv;
+      rq[j] = conj_(sv) * a + c * bv;
+    }
+  }
+}
+
+template <class T>
+int apply_stream(const RotStream& s, T* ev, int64_t n, int64_t k, int nthreads) {
+  nthreads = std::max(1, nthreads);
+  if (nthreads == 1 || k < 64) {
+    apply_stream_rows(s, ev, n, k, 0, k);
+    return 0;
+  }
+  std::vector<std::thread> ws;
+  int64_t step = (k + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t c0 = t * step, c1 = std::min<int64_t>(k, c0 + step);
+    if (c0 >= c1) break;
+    ws.emplace_back([&s, ev, n, k, c0, c1] { apply_stream_rows(s, ev, n, k, c0, c1); });
+  }
+  for (auto& w : ws) w.join();
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
+
+void* dlaf_band2trid_stream_d(int64_t n, int64_t b, double* ab, double* d,
+                              double* e) {
+  auto* s = new RotStream();
+  StreamRecorder<double> rec(s);
+  band2trid_acc<double>(n, b, ab, d, e, rec);
+  return s;
+}
+
+void* dlaf_band2trid_stream_z(int64_t n, int64_t b, void* ab, double* d,
+                              void* e) {
+  auto* s = new RotStream();
+  StreamRecorder<std::complex<double>> rec(s);
+  band2trid_acc<std::complex<double>>(
+      n, b, reinterpret_cast<std::complex<double>*>(ab), d,
+      reinterpret_cast<std::complex<double>*>(e), rec);
+  return s;
+}
+
+int64_t dlaf_stream_size(void* handle) {
+  return int64_t(reinterpret_cast<RotStream*>(handle)->rots.size());
+}
+
+int dlaf_stream_apply_d(void* handle, double* ev, int64_t n, int64_t k,
+                        int nthreads) {
+  return apply_stream<double>(*reinterpret_cast<RotStream*>(handle), ev, n, k,
+                              nthreads);
+}
+
+int dlaf_stream_apply_z(void* handle, void* ev, int64_t n, int64_t k,
+                        int nthreads) {
+  return apply_stream<std::complex<double>>(
+      *reinterpret_cast<RotStream*>(handle),
+      reinterpret_cast<std::complex<double>*>(ev), n, k, nthreads);
+}
+
+void dlaf_stream_free(void* handle) {
+  delete reinterpret_cast<RotStream*>(handle);
+}
 
 int dlaf_band2trid_d(int64_t n, int64_t b, double* ab, double* d, double* e,
                      double* q, int nthreads) {

@@ -159,3 +159,16 @@ def test_heev_single_device_backend(grid_1x1):
         assert tuple(res2.eigenvectors.size) == (m, 6)
         res3 = hermitian_eigensolver("L", mat, backend="pipeline")
         check_eig(a, res3.eigenvalues, res3.eigenvectors.to_global())
+
+
+def test_heev_partial_stream_path(grid_2x4):
+    """Narrow partial spectrum takes the rotation-stream back-transform."""
+    m, nb = 32, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=14)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        res = hermitian_eigensolver("L", mat, spectrum=(0, 3), backend="pipeline")
+        np.testing.assert_allclose(
+            res.eigenvalues, np.linalg.eigvalsh(a)[:4], atol=1e-10
+        )
+        check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
