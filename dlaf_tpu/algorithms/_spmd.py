@@ -51,6 +51,19 @@ class Geometry:
         )
 
 
+def halving_segments(n: int):
+    """Panel-index segments [k0, k1) that halve so each runs with a static
+    trailing-window bucket: ~log2(n) segments, <=2x flop overapproximation.
+    Shared by the bucketed cholesky/trsm/red2band kernels."""
+    segs = []
+    k0 = 0
+    while k0 < n:
+        k1 = min(n, k0 + max(1, (n - k0 + 1) // 2))
+        segs.append((k0, k1))
+        k0 = k1
+    return segs
+
+
 def local_row_tiles(g: Geometry, myr):
     """Global row-tile index of each local row slot: gi[li] = li*Pr + myr."""
     return jnp.arange(g.ltr) * g.pr + myr

@@ -91,18 +91,6 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
     return coll.relocal(x)
 
 
-def _chol_segments(mt: int):
-    """Halving segments [k0, k1) so each runs with a static trailing-window
-    bucket: ~log2(mt) segments, per-segment waste <= 2x."""
-    segs = []
-    k0 = 0
-    while k0 < mt:
-        k1 = min(mt, k0 + max(1, (mt - k0 + 1) // 2))
-        segs.append((k0, k1))
-        k0 = k1
-    return segs
-
-
 def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
     """Bucketed variant of _chol_L_kernel: the trailing update runs on a
     dynamic-sliced window of the local tile stack whose STATIC size shrinks
@@ -143,7 +131,7 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
         xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
         return lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
 
-    for k0, k1 in _chol_segments(g.mt):
+    for k0, k1 in _spmd.halving_segments(g.mt):
         L = min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1)
         C = min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1)
         L, C = max(L, 1), max(C, 1)

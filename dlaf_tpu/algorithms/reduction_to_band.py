@@ -168,10 +168,8 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
         x = _spmd.put_col(x, newcol, lkc)
         return x, taus_all
 
-    from dlaf_tpu.algorithms.cholesky import _chol_segments
-
     carry = (x, taus_all)
-    for k0, k1 in _chol_segments(n_panels):
+    for k0, k1 in _spmd.halving_segments(n_panels):
         L = max(min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1), 1)
         C = max(min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1), 1)
         carry = lax.fori_loop(k0, k1, partial(body, L=L, C=C), carry)

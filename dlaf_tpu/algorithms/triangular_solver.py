@@ -125,16 +125,6 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
     return coll.relocal(b)
 
 
-def _halving_segments(n: int):
-    segs = []
-    s0 = 0
-    while s0 < n:
-        s1 = min(n, s0 + max(1, (n - s0 + 1) // 2))
-        segs.append((s0, s1))
-        s0 = s1
-    return segs
-
-
 def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
     """Bucketed variant of _trsm_left_kernel: the remaining-rows window of B
     (and the A panel) is dynamic-sliced with a static per-segment size, like
@@ -198,7 +188,7 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
         return lax.dynamic_update_slice(b, bs, (rs, 0, 0, 0))
 
-    for s0, s1 in _halving_segments(mt):
+    for s0, s1 in _spmd.halving_segments(mt):
         rem = mt - 1 - s0  # max remaining tiles within the segment
         L = max(min(g_b.ltr, (rem + g_a.pr - 1) // g_a.pr + 1), 1)
         b = lax.fori_loop(s0, s1, partial(step, L=L), b)

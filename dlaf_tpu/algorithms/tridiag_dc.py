@@ -38,15 +38,16 @@ import numpy as np
 
 def secular_solve(d, z, rho, keep=None, iters: int = 70):
     """Roots of the secular function for diag(d) + rho * z z^T, d ascending,
-    rho > 0, with the NON-deflated poles forming a contiguous ascending
-    prefix (deflated entries sorted to the end by the caller; ``keep`` marks
-    active poles — None means all active).
+    rho > 0; ``keep`` marks active (non-deflated) poles — None means all.
 
     Fully vectorized bisection: f(lam) = 1 + rho sum_j z_j^2/(d_j - lam)
-    increases from -inf to +inf between consecutive active poles.  Returns
-    (lam, zhat): root i lies in (d_i, next active pole or global upper
-    bound); zhat is the Loewner-recomputed coupling vector (ratio-paired
-    products for full relative precision — the dlaed3 trick).
+    increases from -inf to +inf between consecutive active poles; the root
+    above pole i is bracketed by (d_i, next active pole | global upper
+    bound).  Each root is then re-anchored to its NEAREST pole (LAPACK
+    laed4's shifted origin) so eigenvector differences lam_i - d_j carry no
+    cancellation.  Returns (lam, zhat, num): zhat is the Loewner-recomputed
+    coupling vector (ratio-paired products — the dlaed3 trick) and
+    num[j, i] = lam_i - d_j in anchored form.
     """
     d = jnp.asarray(d)
     z = jnp.asarray(z)

@@ -11,7 +11,6 @@ import io
 
 import numpy as np
 
-from dlaf_tpu.common.index import iterate_range2d
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 

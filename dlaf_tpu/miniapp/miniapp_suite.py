@@ -72,9 +72,6 @@ def main(argv=None):
 
         band, _ = reduction_to_band(dm(np.tril(herm))())
 
-        class _W:  # adapt: run returns a DistributedMatrix for timing sync
-            pass
-
         def run(a):
             band_to_tridiagonal(band)
             return band
