@@ -137,6 +137,10 @@ def hermitian_eigenvalues(
 
     if uplo == t.UPPER:
         mat_a = mutil.extract_triangle(mutil.hermitize(mat_a, "U"), "L")
+    if mat_a.grid.grid_size.count() == 1 and mat_a.size.rows > 0:
+        # single-device: XLA eigvalsh directly
+        res = _eigh_single_device(mat_a, spectrum)
+        return res.eigenvalues
     band_mat, _ = reduction_to_band(mat_a)
     b2t = band_to_tridiagonal(band_mat, want_q=False)
     if b2t.d.shape[0] == 0:
