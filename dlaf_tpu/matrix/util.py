@@ -127,19 +127,26 @@ def retile(mat: DistributedMatrix, new_block_size) -> DistributedMatrix:
 
 
 def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
-    """Tile-aligned sub-matrix copy (reference: MatrixRef sub-matrix view,
-    matrix/matrix_ref.h:39; functional copy instead of aliasing view)."""
+    """Sub-matrix copy at ANY element origin (reference: MatrixRef sub-matrix
+    view, matrix/matrix_ref.h:39 — tile-aligned there; we re-tile from zero,
+    functional copy instead of aliasing view)."""
     from functools import partial as _p
 
     import jax as _jax
 
     from dlaf_tpu.matrix import layout
-
-    sub_dist = mat.dist.sub_distribution(origin, size)
-    # normalize to source_rank 0 storage for downstream algorithms
     from dlaf_tpu.matrix.distribution import Distribution as _D
 
-    out_dist = _D(sub_dist.size, sub_dist.block_size, sub_dist.grid_size)
+    origin = tuple(int(v) for v in origin)
+    size = tuple(int(v) for v in size)
+    if (
+        origin[0] < 0
+        or origin[1] < 0
+        or origin[0] + size[0] > mat.size.rows
+        or origin[1] + size[1] > mat.size.cols
+    ):
+        raise ValueError(f"sub-matrix {origin}+{size} out of bounds {tuple(mat.size)}")
+    out_dist = _D(size, mat.dist.block_size, mat.dist.grid_size)
 
     @_p(_jax.jit, static_argnums=(1, 2, 3), static_argnames=())
     def _slice(x, d_old, d_new, org):

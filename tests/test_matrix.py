@@ -109,5 +109,8 @@ def test_sub_matrix(grid_2x4):
     m = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
     s = sub_matrix(m, (4, 8), (8, 8))
     np.testing.assert_array_equal(s.to_global(), a[4:12, 8:16])
+    # non-tile-aligned origin (copy re-tiles from zero)
+    s2 = sub_matrix(m, (3, 5), (7, 9))
+    np.testing.assert_array_equal(s2.to_global(), a[3:10, 5:14])
     with pytest.raises(ValueError):
-        sub_matrix(m, (3, 0), (4, 4))
+        sub_matrix(m, (14, 0), (4, 4))
