@@ -23,6 +23,7 @@ BASELINE_GFLOPS = 10000.0
 
 
 def main():
+    from dlaf_tpu.miniapp import common as _c  # enables the persistent compile cache
     import dlaf_tpu.testing as tu
     from dlaf_tpu.algorithms.cholesky import cholesky_factorization
     from dlaf_tpu.comm.grid import Grid

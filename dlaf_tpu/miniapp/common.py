@@ -9,10 +9,20 @@ correctness check on the last run.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
 import numpy as np
+
+# persistent XLA compilation cache: repeated miniapp/bench invocations skip
+# recompiles (the reference has no analogue; compiles are XLA's one-time cost)
+_cache_dir = os.environ.get("DLAF_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dlaf_tpu_xla"))
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
 
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Size2D
