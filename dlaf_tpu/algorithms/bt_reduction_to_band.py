@@ -92,4 +92,4 @@ def bt_reduction_to_band(
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
-    return mat_e.like(_cache[key](mat_band.data, taus_stacked, mat_e.data))
+    return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))

@@ -182,16 +182,18 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
             return layout.pack(layout.pad_global(out, dist), dist)
 
         _local_cache[key] = run
-    return mat_a.like(_local_cache[key](mat_a.data))
+    return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
 def cholesky_factorization(
     uplo: str, mat_a: DistributedMatrix, backend: str = "auto"
 ) -> DistributedMatrix:
-    """Factor the Hermitian positive-definite ``mat_a`` in place: on return
-    the ``uplo`` triangle holds the Cholesky factor (only that triangle is
-    read).  Async: returns immediately, result materializes lazily
-    (reference API: factorization/cholesky.h:72, also graph-building async).
+    """Factor the Hermitian positive-definite ``mat_a``: on return the
+    ``uplo`` triangle holds the Cholesky factor.  Only the ``uplo`` triangle
+    of the input is referenced (LAPACK semantics); the other triangle is
+    returned unchanged (U path) or holds update residue (L path).  Async:
+    returns immediately, the result materializes lazily (reference API:
+    factorization/cholesky.h:72, also graph-building async).
 
     ``backend='auto'`` uses XLA's dense Cholesky on 1x1 grids and the
     distributed SPMD kernel otherwise; 'distributed' forces the kernel.
@@ -207,7 +209,7 @@ def cholesky_factorization(
         return _cholesky_single_device(uplo, mat_a)
     if uplo == t.LOWER:
         data = _compiled(mat_a.grid, g, uplo)(mat_a.data)
-        return mat_a.like(data)
+        return mat_a._inplace(data)
     if uplo == t.UPPER:
         # A = U^H U with U = L^H: mirror the stored upper triangle to lower
         # storage, run the Lower kernel, conj-transpose the factor back

@@ -137,7 +137,7 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
             return layout.pack(layout.pad_global(out, dist), dist)
 
         _local_cache[key] = run
-    return mat_a.like(_local_cache[key](mat_a.data))
+    return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
 def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
@@ -156,7 +156,7 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         _cache[key] = coll.spmd(
             mat_a.grid, partial(kern_fn, g=g, diag=diag), donate_argnums=(0,)
         )
-    return mat_a.like(_cache[key](mat_a.data))
+    return mat_a._inplace(_cache[key](mat_a.data))
 
 
 def inverse_from_cholesky_factor(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:

@@ -199,7 +199,7 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
             return layout.pack(layout.pad_global(out.astype(gc.dtype), dc), dc)
 
         _local_cache[key] = run
-    return mat_c.like(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    return mat_c._inplace(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
@@ -220,7 +220,7 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
         _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
-    return mat_c.like(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def general_multiplication(
@@ -349,7 +349,7 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
         _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
-    return mat_c.like(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _check_mult_shapes(opa, opb, mat_a, mat_b, mat_c):

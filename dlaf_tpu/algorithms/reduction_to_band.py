@@ -201,4 +201,5 @@ def reduction_to_band(mat_a: DistributedMatrix) -> Tuple[DistributedMatrix, jax.
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
     data, taus_stack = _cache[key](full.data)
+    full.data = data  # the hermitized copy was donated
     return mat_a.like(data), taus_stack[0, 0]

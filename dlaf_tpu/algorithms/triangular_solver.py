@@ -220,7 +220,7 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
             return layout.pack(layout.pad_global(out, db), db)
 
         _local_cache[key] = run
-    return mat_b.like(_local_cache[key](mat_a.data, mat_b.data))
+    return mat_b._inplace(_local_cache[key](mat_a.data, mat_b.data))
 
 
 def triangular_solver(
@@ -262,4 +262,4 @@ def triangular_solver(
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))
-    return mat_b.like(_cache[key](mat_a.data, mat_b.data))
+    return mat_b._inplace(_cache[key](mat_a.data, mat_b.data))

@@ -116,6 +116,14 @@ class DistributedMatrix:
     def like(self, data: Optional[jax.Array] = None) -> "DistributedMatrix":
         return DistributedMatrix(self.dist, self.grid, self.data if data is None else data)
 
+    def _inplace(self, data: jax.Array) -> "DistributedMatrix":
+        """In-place result semantics for algorithms that donate this matrix's
+        buffer (reference algorithms mutate their input Matrix): repoint this
+        object at the result so the caller's handle stays valid, and return a
+        fresh handle to the same data."""
+        self.data = data
+        return DistributedMatrix(self.dist, self.grid, data)
+
     # --- host-side access (tests / IO) ---------------------------------------
     def to_global(self) -> np.ndarray:
         """Gather the full matrix to host (reference: test util ``gather``)."""
