@@ -141,14 +141,81 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
     return coll.relocal(x)
 
 
+def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
+    """Lookahead variant (reference: next-panel tasks at high priority while
+    the trailing update runs, factorization/cholesky/impl.h:171-174,280-282).
+
+    Each iteration k: write back panel k, apply the NARROW update to column
+    k+1 only, immediately compute panel k+1 (potrf + trsm + broadcast), THEN
+    run the bulk trailing update excluding column k+1.  Panel k+1's
+    collectives are independent of the bulk einsum, so XLA can overlap them
+    — panel broadcast latency hides under the trailing update on real
+    meshes.  The panel flows through the loop carry."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    gi = _spmd.local_row_tiles(g, myr)
+    gj = _spmd.local_col_tiles(g, myc)
+
+    def compute_panel(x, k):
+        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        lkk = _diag_potrf(d)
+        xc = _spmd.take_col(x, k // g.pc, g)
+        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+        below = (gi > k)[:, None, None]
+        cp = coll.psum_axis(
+            jnp.where(below & (myc == k % g.pc), pan, jnp.zeros_like(pan)), COL_AXIS
+        )
+        return lkk, cp
+
+    def write_back(x, k, lkk, cp):
+        lkc = k // g.pc
+        xc = _spmd.take_col(x, lkc, g)
+        below = (gi > k)[:, None, None]
+        new_col = jnp.where(
+            myc == k % g.pc,
+            jnp.where((gi == k)[:, None, None], lkk[None], jnp.where(below, cp, xc)),
+            xc,
+        )
+        return _spmd.put_col(x, new_col, lkc)
+
+    def body(k, carry):
+        x, lkk, cp = carry
+        x = write_back(x, k, lkk, cp)
+        rp = coll.transpose_panel(cp, g.mt, g.ltc)
+        # narrow update: column k+1 only, so its panel can start immediately
+        l_next = (k + 1) // g.pc
+        xc1 = _spmd.take_col(x, l_next, g)
+        rp1 = _spmd.take_tile(rp, l_next)
+        upd1 = jnp.einsum("iab,cb->iac", cp, rp1.conj())
+        xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
+        x = _spmd.put_col(x, xc1, l_next)
+        # lookahead: panel k+1 from the already-updated column
+        lkk1, cp1 = compute_panel(x, k + 1)
+        # bulk trailing update, column k+1 excluded (already updated)
+        rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
+        x = x - jnp.einsum("iab,jcb->ijac", cp, rp_bulk.conj())
+        return x, lkk1, cp1
+
+    lkk0, cp0 = compute_panel(x, 0)
+    x, lkk, cp = lax.fori_loop(0, g.mt - 1, body, (x, lkk0, cp0))
+    x = write_back(x, g.mt - 1, lkk, cp)
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
 _kernel_cache = {}
 
 
-def _compiled(grid, g: _spmd.Geometry, uplo: str, bucketed: bool = True):
-    key = (id(grid.mesh), g, uplo, bucketed)
+def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
+    key = (id(grid.mesh), g, uplo, variant)
     if key not in _kernel_cache:
-        kern = partial(_chol_L_bucketed_kernel if bucketed else _chol_L_kernel, g=g)
-        _kernel_cache[key] = coll.spmd(grid, kern, donate_argnums=(0,))
+        kern_fn = {
+            "bucketed": _chol_L_bucketed_kernel,
+            "masked": _chol_L_kernel,
+            "lookahead": _chol_L_lookahead_kernel,
+        }[variant]
+        _kernel_cache[key] = coll.spmd(grid, partial(kern_fn, g=g), donate_argnums=(0,))
     return _kernel_cache[key]
 
 
@@ -208,7 +275,10 @@ def cholesky_factorization(
     if backend == "auto" and mat_a.grid.grid_size.count() == 1:
         return _cholesky_single_device(uplo, mat_a)
     if uplo == t.LOWER:
-        data = _compiled(mat_a.grid, g, uplo)(mat_a.data)
+        from dlaf_tpu.tune import get_tune_parameters
+
+        variant = "lookahead" if get_tune_parameters().cholesky_lookahead else "bucketed"
+        data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
         return mat_a._inplace(data)
     if uplo == t.UPPER:
         # A = U^H U with U = L^H: mirror the stored upper triangle to lower

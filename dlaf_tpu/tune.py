@@ -39,6 +39,9 @@ class TuneParameters:
       step (reference bt_band_to_tridiag_hh_apply_group_size, tune.h:105).
     - ``tridiag_host_solver``: 'stemr' (MRRR) or 'stedc'-style host driver
       for the tridiagonal stage.
+    - ``cholesky_lookahead``: use the lookahead SPMD kernel (panel k+1
+      overlapped with the bulk trailing update — benefits multi-chip
+      meshes; the bucketed kernel is the single-chip default).
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -47,6 +50,7 @@ class TuneParameters:
     eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
     bt_apply_group_size: int = field(default_factory=lambda: _env("bt_apply_group_size", 1, int))
     tridiag_host_solver: str = field(default_factory=lambda: _env("tridiag_host_solver", "stemr", str))
+    cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
     debug_dump_eigensolver_data: bool = field(
         default_factory=lambda: _env("debug_dump_eigensolver_data", False, bool)
     )

@@ -45,3 +45,21 @@ def test_cholesky_validation(grid_2x4):
     mat2 = DistributedMatrix.zeros(grid_2x4, (8, 8), (4, 2))
     with pytest.raises(ValueError):
         cholesky_factorization("L", mat2)
+
+
+def test_cholesky_lookahead_variant(comm_grids):
+    """Lookahead kernel matches the bucketed kernel on every grid."""
+    from dlaf_tpu.tune import get_tune_parameters, initialize
+
+    m, mb = 21, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=9)
+    expected = np.linalg.cholesky(a)
+    initialize(cholesky_lookahead=True)
+    try:
+        for grid in comm_grids[:4]:
+            mat = DistributedMatrix.from_global(grid, a, (mb, mb))
+            out = cholesky_factorization("L", mat, backend="distributed")
+            tu.assert_near(out, expected, tu.tol_for(np.float64, m, 40.0), uplo="L")
+    finally:
+        initialize()
+    assert not get_tune_parameters().cholesky_lookahead
