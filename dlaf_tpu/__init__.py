@@ -34,8 +34,11 @@ from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
 from dlaf_tpu.algorithms.eigensolver import (
     EigResult,
     hermitian_eigensolver,
+    hermitian_eigenvalues,
     hermitian_generalized_eigensolver,
 )
+from dlaf_tpu.algorithms.norm import max_norm
+from dlaf_tpu.algorithms.permutations import permute
 
 __version__ = "0.1.0"
 
@@ -60,6 +63,9 @@ __all__ = [
     "bt_reduction_to_band",
     "EigResult",
     "hermitian_eigensolver",
+    "hermitian_eigenvalues",
     "hermitian_generalized_eigensolver",
+    "max_norm",
+    "permute",
     "__version__",
 ]
