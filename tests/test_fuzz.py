@@ -1,0 +1,85 @@
+"""Randomized cross-validation sweep: random (size, tile, grid, dtype)
+combos for every algorithm family against numpy/scipy oracles — coverage
+insurance beyond the hand-picked cases (the reference gets this from its
+large parameterized size lists)."""
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+from dlaf_tpu.algorithms.inverse import triangular_inverse
+from dlaf_tpu.algorithms.multiplication import general_multiplication
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+RNG = np.random.default_rng(2024)
+
+
+def _rand_geometry(grids):
+    m = int(RNG.integers(1, 40))
+    nb = int(RNG.integers(2, 9))
+    grid = grids[int(RNG.integers(len(grids)))]
+    dtype = [np.float32, np.float64, np.complex64, np.complex128][int(RNG.integers(4))]
+    return m, nb, grid, dtype
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_fuzz_cholesky(comm_grids, trial):
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    a = tu.random_hermitian_pd(m, dtype, seed=trial)
+    mat = DistributedMatrix.from_global(grid, a, (nb, nb))
+    out = cholesky_factorization("L", mat)
+    tu.assert_near(out, np.linalg.cholesky(a), tu.tol_for(dtype, m, 100.0), uplo="L")
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_fuzz_trsm(comm_grids, trial):
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    n = int(RNG.integers(1, 30))
+    a = tu.random_triangular(m, dtype, lower=True, seed=trial)
+    b = tu.random_matrix(m, n, dtype, seed=trial + 1)
+    ma = DistributedMatrix.from_global(grid, a, (nb, nb))
+    mb = DistributedMatrix.from_global(grid, b, (nb, nb))
+    out = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, ma, mb)
+    tu.assert_near(out, sla.solve_triangular(a, b, lower=True), tu.tol_for(dtype, m, 500.0))
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_fuzz_gemm(comm_grids, trial):
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    n = int(RNG.integers(1, 30))
+    k = int(RNG.integers(1, 30))
+    a = tu.random_matrix(m, k, dtype, seed=trial)
+    b = tu.random_matrix(k, n, dtype, seed=trial + 1)
+    c = tu.random_matrix(m, n, dtype, seed=trial + 2)
+    ma = DistributedMatrix.from_global(grid, a, (nb, nb))
+    mb = DistributedMatrix.from_global(grid, b, (nb, nb))
+    mc = DistributedMatrix.from_global(grid, c, (nb, nb))
+    out = general_multiplication("N", "N", 1.0, ma, mb, -0.5, mc)
+    tu.assert_near(out, a @ b - 0.5 * c, tu.tol_for(dtype, max(m, k), 100.0))
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_fuzz_trtri(comm_grids, trial):
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    a = tu.random_triangular(m, dtype, lower=True, seed=trial)
+    mat = DistributedMatrix.from_global(grid, a, (nb, nb))
+    out = triangular_inverse("L", "N", mat)
+    tu.assert_near(out, np.linalg.inv(a), tu.tol_for(dtype, m, 1000.0), uplo="L")
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_fuzz_heev(comm_grids, trial):
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    if np.dtype(dtype) in (np.dtype(np.float32), np.dtype(np.complex64)):
+        dtype = np.float64 if np.dtype(dtype).kind == "f" else np.complex128
+    a = tu.random_hermitian_pd(m, dtype, seed=trial)
+    mat = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+    res = hermitian_eigensolver("L", mat)
+    v = res.eigenvectors.to_global()
+    tol = tu.tol_for(dtype, m, 2000.0)
+    assert np.abs(a @ v - v * res.eigenvalues[None, :]).max() < tol * max(np.abs(a).max(), 1)
+    assert np.abs(v.conj().T @ v - np.eye(m)).max() < tol
