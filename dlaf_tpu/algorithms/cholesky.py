@@ -253,7 +253,7 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
 
 
 def cholesky_factorization(
-    uplo: str, mat_a: DistributedMatrix, backend: str = "auto"
+    uplo: str, mat_a: DistributedMatrix, backend: str = "auto", _dump: bool = True
 ) -> DistributedMatrix:
     """Factor the Hermitian positive-definite ``mat_a``: on return the
     ``uplo`` triangle holds the Cholesky factor.  Only the ``uplo`` triangle
@@ -272,6 +272,10 @@ def cholesky_factorization(
     g = _spmd.Geometry.of(mat_a.dist)
     if g.mt == 0:
         return mat_a
+    if _dump:
+        from dlaf_tpu.matrix.io import maybe_dump
+
+        maybe_dump("debug_dump_cholesky_data", "dlaf_dump_cholesky_input.npz", mat_a)
     if backend == "auto" and mat_a.grid.grid_size.count() == 1:
         return _cholesky_single_device(uplo, mat_a)
     if uplo == t.LOWER:
@@ -289,7 +293,7 @@ def cholesky_factorization(
         from dlaf_tpu.matrix import util as mutil
 
         low = mutil.transpose(mutil.extract_triangle(mat_a, "U"), conj=True)
-        fac = cholesky_factorization(t.LOWER, low)
+        fac = cholesky_factorization(t.LOWER, low, _dump=False)
         u = mutil.transpose(mutil.extract_triangle(fac, "L"), conj=True)
         # keep the caller's original lower triangle untouched (LAPACK-style)
         return mat_a.like(

@@ -56,6 +56,9 @@ def hermitian_eigensolver(
     analogous to the reference offloading tile work to cuSOLVER) and
     multi-device grids to the distributed band-reduction pipeline;
     'pipeline' forces the latter everywhere."""
+    from dlaf_tpu.matrix.io import maybe_dump
+
+    maybe_dump("debug_dump_eigensolver_data", "dlaf_dump_eigensolver_input.npz", mat_a)
     if uplo == t.UPPER:
         # lower-storage pipeline on the mirrored matrix
         mat_a = mutil.extract_triangle(mutil.hermitize(mat_a, "U"), "L")

@@ -18,6 +18,15 @@ from dlaf_tpu.common.index import Size2D
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 
+def maybe_dump(flag_name: str, path: str, mat: DistributedMatrix) -> None:
+    """Debug-dump hook: save ``mat`` when the tune flag is set
+    (reference debug_dump_* flags, tune.h:30-67)."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    if getattr(get_tune_parameters(), flag_name):
+        save(path, mat)
+
+
 def save(path: str, mat: DistributedMatrix) -> None:
     """Save a matrix (gathered) + metadata to one .npz."""
     np.savez_compressed(

@@ -83,3 +83,30 @@ def test_tune(monkeypatch):
     assert p2.eigensolver_min_band == 64
     with pytest.raises(ValueError):
         p2.update(not_a_knob=1)
+
+
+@pytest.mark.parametrize("uplo", "LU")
+def test_debug_dump_hooks(tmp_path, grid_2x4, monkeypatch, uplo):
+    """tune.debug_dump_* flags dump the CALLER's input, for both uplos and
+    both hooked algorithms (reference tune.h:30-67)."""
+    import os
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+    from dlaf_tpu.tune import initialize
+
+    monkeypatch.chdir(tmp_path)
+    initialize(debug_dump_cholesky_data=True, debug_dump_eigensolver_data=True)
+    try:
+        a = tu.random_hermitian_pd(8, np.float64, seed=1)
+        stored = np.tril(a) if uplo == "L" else np.triu(a)
+        cholesky_factorization(uplo, DistributedMatrix.from_global(grid_2x4, stored, (4, 4)))
+        assert os.path.exists("dlaf_dump_cholesky_input.npz")
+        with np.load("dlaf_dump_cholesky_input.npz") as z:
+            np.testing.assert_allclose(z["data"], stored)  # caller's input
+        hermitian_eigensolver(uplo, DistributedMatrix.from_global(grid_2x4, stored, (4, 4)))
+        with np.load("dlaf_dump_eigensolver_input.npz") as z:
+            np.testing.assert_allclose(z["data"], stored)
+    finally:
+        initialize()
