@@ -172,3 +172,51 @@ def test_heev_partial_stream_path(grid_2x4):
             res.eigenvalues, np.linalg.eigvalsh(a)[:4], atol=1e-10
         )
         check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
+
+
+def test_hegv_upper(grid_2x4):
+    m, nb, dtype = 12, 4, np.float64
+    a = tu.random_hermitian_pd(m, dtype, seed=15)
+    b = tu.random_hermitian_pd(m, dtype, seed=16)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.triu(a), (nb, nb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, np.triu(b), (nb, nb))
+    res = hermitian_generalized_eigensolver("U", mat_a, mat_b)
+    w_ref = sla.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(res.eigenvalues, w_ref, atol=tu.tol_for(dtype, m, 2000.0))
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global(), b=b,
+              tol=tu.tol_for(dtype, m, 2000.0))
+
+
+def test_native_rotation_stream(grid_2x4):
+    """Compact band-stage back-transform: stream.apply == Q2 @ E."""
+    from dlaf_tpu.algorithms.band_to_tridiag import (
+        band_to_tridiagonal,
+        band_to_tridiagonal_stream,
+    )
+
+    m, nb = 16, 4
+    for dtype in [np.float64, np.complex128]:
+        a = tu.random_hermitian_pd(m, dtype, seed=17)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        band_mat, _ = reduction_to_band(mat)
+        st = band_to_tridiagonal_stream(band_mat)
+        if st is None:
+            pytest.skip("native library unavailable")
+        d_, e_, phases, stream = st
+        full = band_to_tridiagonal(band_mat)
+        np.testing.assert_allclose(np.sort(d_), np.sort(full.d), atol=1e-10)
+        # both reductions must produce eigenvalue-identical tridiagonals
+        trid_n = np.diag(d_) + np.diag(e_, 1) + np.diag(e_, -1)
+        trid_f = np.diag(full.d) + np.diag(full.e, 1) + np.diag(full.e, -1)
+        np.testing.assert_allclose(
+            np.linalg.eigvalsh(trid_n), np.linalg.eigvalsh(trid_f), atol=1e-10
+        )
+        # Q2 from the stream (applied to I) must be unitary and reduce the band
+        q2 = stream.apply(phases[:, None] * np.eye(m, dtype=dtype))
+        np.testing.assert_allclose(q2.conj().T @ q2, np.eye(m), atol=1e-12)
+        from dlaf_tpu.algorithms.band_to_tridiag import extract_band_host
+
+        bfull = extract_band_host(band_mat, nb)
+        np.testing.assert_allclose(
+            q2.conj().T @ bfull @ q2, trid_n, atol=1e-10
+        )
