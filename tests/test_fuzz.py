@@ -83,3 +83,40 @@ def test_fuzz_heev(comm_grids, trial):
     tol = tu.tol_for(dtype, m, 2000.0)
     assert np.abs(a @ v - v * res.eigenvalues[None, :]).max() < tol * max(np.abs(a).max(), 1)
     assert np.abs(v.conj().T @ v - np.eye(m)).max() < tol
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_fuzz_red2band(comm_grids, trial):
+    from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    if np.dtype(dtype) in (np.dtype(np.float32), np.dtype(np.complex64)):
+        dtype = np.float64 if np.dtype(dtype).kind == "f" else np.complex128
+    a = tu.random_hermitian_pd(m, dtype, seed=trial + 50)
+    mat = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+    band_mat, taus = reduction_to_band(mat)
+    # similarity: band matrix eigenvalues == A eigenvalues
+    og = band_mat.to_global()
+    i, j = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
+    band = np.where((i - j <= nb) & (i >= j), og, 0)
+    herm = np.tril(band) + np.tril(band, -1).conj().T
+    np.testing.assert_allclose(
+        np.linalg.eigvalsh(herm), np.linalg.eigvalsh(a),
+        atol=tu.tol_for(dtype, m, 200.0) * max(np.abs(a).max(), 1),
+    )
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_fuzz_hemm(comm_grids, trial):
+    from dlaf_tpu.algorithms.multiplication import hermitian_multiplication
+
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    n = int(RNG.integers(1, 20))
+    h = tu.random_hermitian_pd(m, dtype, seed=trial + 70)
+    b = tu.random_matrix(m, n, dtype, seed=trial + 71)
+    c = tu.random_matrix(m, n, dtype, seed=trial + 72)
+    ma = DistributedMatrix.from_global(grid, np.tril(h), (nb, nb))
+    mb = DistributedMatrix.from_global(grid, b, (nb, nb))
+    mc = DistributedMatrix.from_global(grid, c, (nb, nb))
+    out = hermitian_multiplication(t.LEFT, "L", 1.0, ma, mb, 0.5, mc)
+    tu.assert_near(out, h @ b + 0.5 * c, tu.tol_for(dtype, m, 200.0))
