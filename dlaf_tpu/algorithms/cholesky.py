@@ -269,6 +269,9 @@ def cholesky_factorization(
         raise ValueError("cholesky: matrix must be square")
     if mat_a.block_size.rows != mat_a.block_size.cols:
         raise ValueError("cholesky: tiles must be square")
+    from dlaf_tpu.common import checks
+
+    checks.assert_hermitian_heavy(mat_a, uplo)
     g = _spmd.Geometry.of(mat_a.dist)
     if g.mt == 0:
         return mat_a

@@ -1,0 +1,81 @@
+"""Leveled runtime assertions.
+
+Analogue of the reference's three-level assertion machinery
+(reference: include/dlaf/common/assert.h — DLAF_ASSERT (irrefutable),
+DLAF_ASSERT_MODERATE, DLAF_ASSERT_HEAVY, enabled by CMake flags and printing
+the offending expression values).  Here the level is an env/runtime setting:
+
+  DLAF_TPU_CHECK_LEVEL = 0  irrefutable only (API misuse; always on)
+                         1  moderate (cheap invariants; the default)
+                         2  heavy (host round-trips / O(N^2) validation,
+                            e.g. gathering a matrix to check Hermitianity)
+
+Checks format their message with the offending values like the reference
+macros do.  Heavy checks are free to device_get.
+"""
+from __future__ import annotations
+
+import os
+
+_LEVEL = None
+
+
+def check_level() -> int:
+    global _LEVEL
+    if _LEVEL is None:
+        try:
+            _LEVEL = int(os.environ.get("DLAF_TPU_CHECK_LEVEL", "1"))
+        except ValueError:
+            _LEVEL = 1
+    return _LEVEL
+
+
+def set_check_level(level: int) -> None:
+    global _LEVEL
+    _LEVEL = int(level)
+
+
+def _fail(kind: str, message: str, values: dict):
+    rendered = ", ".join(f"{k}={v!r}" for k, v in values.items())
+    raise AssertionError(f"[{kind}] {message}" + (f" ({rendered})" if rendered else ""))
+
+
+def assert_irrefutable(cond: bool, message: str, **values) -> None:
+    """Always-on API-contract check (DLAF_ASSERT)."""
+    if not cond:
+        _fail("irrefutable", message, values)
+
+
+def assert_moderate(cond_fn, message: str, **values) -> None:
+    """Cheap invariant, on at level >= 1 (DLAF_ASSERT_MODERATE).
+    ``cond_fn`` may be a bool or a thunk (evaluated only when enabled)."""
+    if check_level() >= 1:
+        cond = cond_fn() if callable(cond_fn) else cond_fn
+        if not cond:
+            _fail("moderate", message, values)
+
+
+def assert_heavy(cond_fn, message: str, **values) -> None:
+    """Expensive validation, on at level >= 2 (DLAF_ASSERT_HEAVY); the thunk
+    may gather device data."""
+    if check_level() >= 2:
+        cond = cond_fn() if callable(cond_fn) else cond_fn
+        if not cond:
+            _fail("heavy", message, values)
+
+
+def assert_hermitian_heavy(mat, uplo: str = "L", tol: float = 1e-5) -> None:
+    """Heavy check: the stored ``uplo`` triangle mirrors to a Hermitian
+    matrix whose diagonal is real (catches wrong-triangle inputs early)."""
+    if check_level() < 2:
+        return
+    import numpy as np
+
+    g = mat.to_global()
+    diag_imag = float(np.abs(np.imag(np.diagonal(g))).max()) if np.iscomplexobj(g) else 0.0
+    assert_heavy(
+        diag_imag <= tol,
+        "matrix diagonal must be real for a Hermitian operand",
+        max_imag=diag_imag,
+        uplo=uplo,
+    )

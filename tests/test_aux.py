@@ -53,3 +53,31 @@ def test_cholesky_upper(grid_2x4):
     # lower original values preserved
     og = out.to_global()
     np.testing.assert_array_equal(np.tril(og, -1), np.tril(stored, -1))
+
+
+def test_check_levels(grid_2x4, monkeypatch):
+    """Leveled assertions (reference common/assert.h three tiers)."""
+    from dlaf_tpu.common import checks
+
+    checks.set_check_level(0)
+    checks.assert_irrefutable(True, "ok")
+    with pytest.raises(AssertionError, match="irrefutable"):
+        checks.assert_irrefutable(False, "bad arg", got=3)
+    # moderate/heavy disabled at level 0 — thunks must not even run
+    checks.assert_moderate(lambda: 1 / 0, "not evaluated")
+    checks.assert_heavy(lambda: 1 / 0, "not evaluated")
+    checks.set_check_level(1)
+    with pytest.raises(AssertionError, match="moderate"):
+        checks.assert_moderate(False, "invariant", k=1)
+    checks.assert_heavy(lambda: 1 / 0, "still not evaluated")
+    checks.set_check_level(2)
+    with pytest.raises(AssertionError, match="heavy"):
+        checks.assert_heavy(lambda: False, "deep check")
+    # heavy Hermitian check catches an imaginary diagonal
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+
+    bad = np.eye(8, dtype=np.complex128) * (1 + 1j)
+    mat = DistributedMatrix.from_global(grid_2x4, bad, (4, 4))
+    with pytest.raises(AssertionError, match="diagonal"):
+        cholesky_factorization("L", mat)
+    checks.set_check_level(1)
