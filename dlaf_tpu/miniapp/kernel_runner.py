@@ -1,0 +1,93 @@
+"""Micro-kernel benchmark runner.
+
+Analogue of the reference's kernel miniapps
+(reference: miniapp/include/dlaf/miniapp/kernel_runner.h + miniapp/kernel/
+larft/laset drivers): time individual tile-level kernels in isolation to
+guide tile-size / backend tuning.
+
+Usage: python -m dlaf_tpu.miniapp.kernel_runner [--nb 256] [--batch 16]
+           [--type s] [--nreps 30] [--kernels potrf,trsm,gemm,tfactor]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.miniapp.common import DTYPES, sync
+from dlaf_tpu.ops import tile as t
+
+
+def _time(fn, *args, nreps: int) -> float:
+    r = fn(*args)
+    sync(r[0] if isinstance(r, tuple) else r)
+    t0 = time.perf_counter()
+    for _ in range(nreps):
+        r = fn(*args)
+    sync(r[0] if isinstance(r, tuple) else r)
+    return (time.perf_counter() - t0) / nreps
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nb", type=int, default=256)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--type", choices="sdcz", default="s")
+    p.add_argument("--nreps", type=int, default=30)
+    p.add_argument("--kernels", default="potrf,potrf_pallas,trsm,gemm,tfactor")
+    args = p.parse_args(argv)
+    dtype = DTYPES[args.type]
+    if np.dtype(dtype).itemsize == 8:
+        jax.config.update("jax_enable_x64", True)
+    nb, bt = args.nb, args.batch
+
+    h = jnp.asarray(tu.random_hermitian_pd(nb, dtype, 0))
+    l = jnp.asarray(tu.random_triangular(nb, dtype, lower=True, seed=1))
+    panel = jnp.asarray(tu.random_matrix(bt * nb, nb, dtype, 2)).reshape(bt, nb, nb)
+    a = jnp.asarray(tu.random_matrix(nb, nb, dtype, 3))
+    v = jnp.asarray(tu.random_matrix(bt * nb, nb, dtype, 4))
+    taus = jnp.asarray(np.full(nb, 1.5, np.dtype(dtype)))
+
+    runners = {}
+    runners["potrf"] = (jax.jit(lambda x: t.potrf(x)), (h,), nb**3 / 3)
+    try:
+        from dlaf_tpu.ops import pallas_potrf
+
+        if pallas_potrf.supported(h) and jax.default_backend() == "tpu":
+            runners["potrf_pallas"] = (pallas_potrf.potrf_tile, (h,), nb**3 / 3)
+    except Exception:
+        pass
+    runners["trsm"] = (
+        jax.jit(lambda lk, b: t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lk, b)),
+        (l, panel),
+        bt * nb**3,
+    )
+    runners["gemm"] = (
+        jax.jit(lambda x, y: jnp.einsum("iab,jcb->ijac", x, y)),
+        (panel, panel),
+        2 * bt * bt * nb**3,
+    )
+    from dlaf_tpu.algorithms.reduction_to_band import _t_factor
+
+    runners["tfactor"] = (
+        jax.jit(lambda vv, tt: _t_factor(vv.reshape(-1, nb), tt, nb)),
+        (v, taus),
+        bt * nb**3,  # dominated by V^H V
+    )
+
+    for name in args.kernels.split(","):
+        if name not in runners:
+            continue
+        fn, fargs, flops = runners[name]
+        dt_s = _time(fn, *fargs, nreps=args.nreps)
+        print(f"{name:14s} nb={nb} batch={bt} {np.dtype(dtype).name:10s} "
+              f"{dt_s*1e3:9.3f} ms {flops/dt_s/1e9:10.1f} GFlop/s")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

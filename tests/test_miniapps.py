@@ -43,3 +43,9 @@ def test_miniapp_gen_eigensolver():
 def test_miniapp_suite(name):
     res = miniapp_suite.main([name] + ARGS)
     assert res and len(res) == 1
+
+
+def test_kernel_runner():
+    from dlaf_tpu.miniapp import kernel_runner
+
+    assert kernel_runner.main(["--nb", "16", "--batch", "2", "--nreps", "1"]) == 0
