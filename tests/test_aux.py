@@ -59,6 +59,13 @@ def test_check_levels(grid_2x4, monkeypatch):
     """Leveled assertions (reference common/assert.h three tiers)."""
     from dlaf_tpu.common import checks
 
+    try:
+        _run_check_level_cases(checks, grid_2x4)
+    finally:
+        checks.set_check_level(1)
+
+
+def _run_check_level_cases(checks, grid_2x4):
     checks.set_check_level(0)
     checks.assert_irrefutable(True, "ok")
     with pytest.raises(AssertionError, match="irrefutable"):
@@ -80,4 +87,3 @@ def test_check_levels(grid_2x4, monkeypatch):
     mat = DistributedMatrix.from_global(grid_2x4, bad, (4, 4))
     with pytest.raises(AssertionError, match="diagonal"):
         cholesky_factorization("L", mat)
-    checks.set_check_level(1)
