@@ -74,14 +74,12 @@ def extract_band_storage(mat: DistributedMatrix, band: int) -> np.ndarray:
             ab[off, r0 : r0 + sz - off] += np.diagonal(dt_, -off)
         if i + 1 < mt:
             st = np.triu(mat.get_tile((i + 1, i)))
-            sz1 = st.shape[0]
             # subdiag tile element (a, b) is global (r0+nb+a, r0+b):
-            # offset = nb + a - b in [1, band]
-            for a_ in range(sz1):
-                for b_ in range(a_, st.shape[1]):
-                    off = nb + a_ - b_
-                    if 1 <= off <= band:
-                        ab[off, r0 + b_] = st[a_, b_]
+            # offset = nb + a - b in [1, band] — i.e. tile diagonal k = b - a
+            # in [nb-band, nb-1]; scatter one diagonal (vector) at a time
+            for k in range(max(0, nb - band), min(st.shape[1], nb)):
+                diagv = np.diagonal(st, k)
+                ab[nb - k, r0 + k : r0 + k + diagv.shape[0]] = diagv
     return ab
 
 

@@ -88,7 +88,7 @@ def bt_reduction_to_band(
         taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
     )
     taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
-    key = (id(mat_e.grid.mesh), g_a, g_e, n_panels)
+    key = (mat_e.grid.cache_key, g_a, g_e, n_panels)
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))

@@ -208,7 +208,7 @@ _kernel_cache = {}
 
 
 def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
-    key = (id(grid.mesh), g, uplo, variant)
+    key = (grid.cache_key, g, uplo, variant)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,

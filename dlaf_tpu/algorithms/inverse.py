@@ -150,7 +150,7 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         return mat_a
     if mat_a.grid.grid_size.count() == 1:
         return _trtri_single_device(uplo, diag, mat_a)
-    key = (id(mat_a.grid.mesh), uplo, diag, g)
+    key = (mat_a.grid.cache_key, uplo, diag, g)
     if key not in _cache:
         kern_fn = _trtri_lower_kernel if uplo == t.LOWER else _trtri_upper_kernel
         _cache[key] = coll.spmd(

@@ -211,7 +211,7 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
     if mat_c.grid.grid_size.count() == 1:
         return _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, False)
     key = (
-        id(mat_c.grid.mesh), opa, opb, complex(alpha), complex(beta), structure,
+        mat_c.grid.cache_key, opa, opb, complex(alpha), complex(beta), structure,
         diag, kt, g_a, g_b, g_c,
     )
     if key not in _cache:
@@ -340,7 +340,7 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
         return _run_dense_local(mat_a, mat_b, mat_c, opa, t.NO_TRANS, alpha, beta, structure, diag, True)
     kt = g_b.nt
     key = (
-        "right", id(mat_c.grid.mesh), opa, complex(alpha), complex(beta),
+        "right", mat_c.grid.cache_key, opa, complex(alpha), complex(beta),
         structure, diag, kt, g_a, g_b, g_c,
     )
     if key not in _cache:

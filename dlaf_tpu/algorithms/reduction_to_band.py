@@ -196,7 +196,7 @@ def reduction_to_band(mat_a: DistributedMatrix) -> Tuple[DistributedMatrix, jax.
     full = mutil.hermitize(mat_a, "L")
     if n_panels == 0:
         return full, jnp.zeros((0, g.nb), mat_a.dtype)
-    key = (id(mat_a.grid.mesh), g)
+    key = (mat_a.grid.cache_key, g)
     if key not in _cache:
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))

@@ -258,7 +258,7 @@ def triangular_solver(
                 # remember and use the tiled SPMD kernel instead
                 _local_cache[fail_key] = True
     kern_fn = _trsm_left_bucketed_kernel if side == t.LEFT else _trsm_right_kernel
-    key = (id(mat_b.grid.mesh), side, uplo, op, diag, complex(alpha), g_a, g_b)
+    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

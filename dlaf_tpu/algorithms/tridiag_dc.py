@@ -74,7 +74,8 @@ def secular_solve(d, z, rho, keep=None, iters: int = 70):
 
         def f(off):
             diff = anchor_gap - off[:, None]  # [i, j] = d_j - (anchor_i + off_i)
-            safe = jnp.where(diff == 0, 1e-300, diff)
+            tiny = jnp.finfo(d.dtype).tiny  # dtype-aware: 1e-300 underflows in f32
+            safe = jnp.where(diff == 0, tiny, diff)
             return 1.0 + rho * jnp.sum(z2[None, :] / safe, axis=1)
 
         def body(_, carry):
@@ -138,7 +139,7 @@ def _pole_deflate(ds, zs, keep, tol_gap):
         close = (ds[j + 1] - ds[j] < tol_gap) & kp[j] & kp[j + 1]
         zj, zj1 = z[j], z[j + 1]
         r = jnp.sqrt(zj * zj + zj1 * zj1)
-        rsafe = jnp.maximum(r, 1e-300)
+        rsafe = jnp.maximum(r, jnp.finfo(ds.dtype).tiny)
         c = jnp.where(close, zj1 / rsafe, 1.0)
         s = jnp.where(close, zj / rsafe, 0.0)
         # R^T [zj, zj1] = [0, r]
@@ -170,14 +171,16 @@ def _merge_eigh(d, z, rho, deflate_tol):
     order = jnp.argsort(d)
     ds = d[order]
     zs = z[order]
-    keep = jnp.abs(zs) * jnp.sqrt(jnp.abs(rho)) > deflate_tol * jnp.sqrt(zn2 + 1e-300)
+    keep = jnp.abs(zs) * jnp.sqrt(jnp.abs(rho)) > deflate_tol * jnp.sqrt(
+        zn2 + jnp.finfo(d.dtype).tiny
+    )
     zs = jnp.where(keep, zs, 0.0)
     span = jnp.max(jnp.abs(ds)) + rho * zn2 + 1.0
     zs, keep, g = _pole_deflate(ds, zs, keep, deflate_tol * span)
     lam, zhat, num = secular_solve(ds, zs, rho, keep=keep)
     # eigenvectors: u_i ∝ zhat_j / (ds_j - lam_i) = -zhat_j / num[j, i]
     # (num from the cancellation-free anchored form)
-    safe = jnp.where(num == 0, 1e-300, num)
+    safe = jnp.where(num == 0, jnp.finfo(d.dtype).tiny, num)
     u = -zhat[:, None] / safe
     norms = jnp.sqrt(jnp.sum(u * u, axis=0))
     u = u / jnp.where(norms > 0, norms, 1.0)

@@ -36,6 +36,8 @@ class Grid:
         if tuple(mesh.axis_names) != (ROW_AXIS, COL_AXIS):
             raise ValueError(f"grid mesh must have axes ('r','c'), got {mesh.axis_names}")
         self.mesh = mesh
+        devs = mesh.devices
+        self._cache_key = (devs.shape, tuple((d.platform, d.id) for d in devs.flat))
 
     @classmethod
     def create(
@@ -89,6 +91,14 @@ class Grid:
 
     def col_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(COL_AXIS))
+
+    @property
+    def cache_key(self) -> tuple:
+        """Stable key for compiled-kernel caches.  ``id(mesh)`` is unsafe —
+        a dead mesh's id can be reused by a new object, resurrecting a stale
+        compiled kernel with donated-buffer shapes — so key on the device
+        identities + grid shape (precomputed: the mesh is immutable)."""
+        return self._cache_key
 
     def __repr__(self):
         return f"Grid({self.grid_size.rows}x{self.grid_size.cols})"

@@ -65,17 +65,29 @@ def assert_heavy(cond_fn, message: str, **values) -> None:
 
 
 def assert_hermitian_heavy(mat, uplo: str = "L", tol: float = 1e-5) -> None:
-    """Heavy check: the stored ``uplo`` triangle mirrors to a Hermitian
-    matrix whose diagonal is real (catches wrong-triangle inputs early)."""
+    """Heavy check on a Hermitian operand stored in the ``uplo`` triangle
+    (LAPACK semantics: the other triangle is unreferenced and may hold
+    anything, so full-symmetry cannot be checked).  Validates what CAN be:
+    the stored triangle is finite (no NaN/Inf) and the diagonal is real for
+    complex dtypes."""
     if check_level() < 2:
         return
     import numpy as np
 
     g = mat.to_global()
-    diag_imag = float(np.abs(np.imag(np.diagonal(g))).max()) if np.iscomplexobj(g) else 0.0
+    stored = np.tril(g) if uplo == "L" else np.triu(g)
+    n_bad = int(np.count_nonzero(~np.isfinite(stored)))
     assert_heavy(
-        diag_imag <= tol,
-        "matrix diagonal must be real for a Hermitian operand",
-        max_imag=diag_imag,
+        n_bad == 0,
+        "stored triangle of a Hermitian operand must be finite",
+        nonfinite_count=n_bad,
         uplo=uplo,
     )
+    if np.iscomplexobj(g):
+        diag_imag = float(np.abs(np.imag(np.diagonal(g))).max())
+        assert_heavy(
+            diag_imag <= tol,
+            "matrix diagonal must be real for a Hermitian operand",
+            max_imag=diag_imag,
+            uplo=uplo,
+        )

@@ -26,15 +26,62 @@ _tried = False
 
 
 def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        "-o", _SO, _SRC, "-lpthread",
-    ]
+    """Compile to a temp file and atomically rename: concurrent processes
+    (or a shared package dir across hosts) must never observe a half-written
+    .so.  Cross-process exclusion via an flock'd lockfile; -march=x86-64-v3
+    instead of native so a .so built on one host doesn't SIGILL on another
+    sharing the directory (falls back to -march=native if v3 unsupported)."""
+    import tempfile
+
+    lock_path = _SO + ".lock"
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-        return r.returncode == 0
+        import fcntl
+
+        lock_f = open(lock_path, "w")
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+    except Exception:
+        lock_f = None
+    tmp = None
+    try:
+        # another process may have finished the build while we waited
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        # x86-64-v3 compiles regardless of the build host's CPU, so gate it
+        # on actual AVX2 support; otherwise (pre-AVX2 x86, non-x86) use
+        # -march=native.  Overridable for shared-package-dir deployments.
+        march = os.environ.get("DLAF_TPU_NATIVE_MARCH")
+        if march is None:
+            try:
+                with open("/proc/cpuinfo") as f:
+                    march = "x86-64-v3" if " avx2 " in f.read().replace("\t", " ") else "native"
+            except OSError:
+                march = "native"
+        for m in dict.fromkeys([march, "native"]):
+            cmd = [
+                "g++", "-O3", f"-march={m}", "-shared", "-fPIC", "-std=c++17",
+                "-o", tmp, _SRC, "-lpthread",
+            ]
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+            except Exception:
+                continue
+            if r.returncode == 0:
+                os.chmod(tmp, 0o755)
+                os.rename(tmp, _SO)
+                return True
+        return False
     except Exception:
         return False
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        if lock_f is not None:
+            lock_f.close()
 
 
 def get_lib():
