@@ -146,14 +146,12 @@ def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = N
         E_band = stream.apply(phases[:, None] * E)
 
     (phases fold the complex subdiagonal normalization).  Returns None when
-    the native library or dtype support is unavailable."""
+    the native library is unavailable."""
     from dlaf_tpu.native import band2trid_stream
 
     if band is None:
         band = mat_band.block_size.rows
     dt = np.dtype(mat_band.dtype)
-    if dt not in (np.dtype(np.float64), np.dtype(np.complex128)):
-        return None
     m = mat_band.size.rows
     if m == 0:
         return None

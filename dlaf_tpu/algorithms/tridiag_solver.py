@@ -33,13 +33,16 @@ def tridiagonal_eigensolver(
     dtype=np.float64,
     spectrum: Optional[Tuple[int, int]] = None,
     backend: str = "host",
+    return_host: bool = False,
 ) -> Tuple[np.ndarray, DistributedMatrix]:
     """Eigendecomposition of the real symmetric tridiagonal (d, e).
 
     Returns (eigenvalues ascending [host], eigenvector DistributedMatrix of
     shape n x k distributed over ``grid``).  ``spectrum=(il, iu)`` selects
     eigenvalue indices il..iu inclusive (0-based), mirroring the reference's
-    eigenvalues_index_begin/end.
+    eigenvalues_index_begin/end.  ``return_host=True`` returns the
+    eigenvector block as a host ndarray instead (for callers that apply a
+    host-side transform next, avoiding a device round-trip).
 
     Backends: 'host' = LAPACK MRRR via scipy; 'dc' = on-device Cuppen
     divide & conquer (tridiag_dc.py — the reference's algorithm, vectorized
@@ -47,6 +50,8 @@ def tridiagonal_eigensolver(
     n = d.shape[0]
     if n == 0:
         w = np.zeros(0, np.dtype(dtype))
+        if return_host:
+            return w, np.zeros((0, 0), np.dtype(dtype))
         mat = DistributedMatrix.zeros(grid, (0, 0), (block_size, block_size), dtype)
         return w, mat
     if backend == "dc_dist":
@@ -56,10 +61,12 @@ def tridiagonal_eigensolver(
         if spectrum is not None:
             il, iu = spectrum
             w = w[il : iu + 1]
-            mat = DistributedMatrix.from_global(
-                grid, mat.to_global()[:, il : iu + 1].astype(np.dtype(dtype)), (block_size, block_size)
-            )
-            return w, mat
+            v = mat.to_global()[:, il : iu + 1].astype(np.dtype(dtype))
+            if return_host:
+                return w, v
+            return w, DistributedMatrix.from_global(grid, v, (block_size, block_size))
+        if return_host:
+            return w, mat.to_global().astype(np.dtype(dtype))
         if np.dtype(dtype).kind == "c":
             mat = DistributedMatrix.from_global(
                 grid, mat.to_global().astype(np.dtype(dtype)), (block_size, block_size)
@@ -81,5 +88,8 @@ def tridiagonal_eigensolver(
         il, iu = spectrum
         w, v = sla.eigh_tridiagonal(d, e, select="i", select_range=(il, iu))
     v = v.astype(np.dtype(dtype))
+    w = w.astype(v.real.dtype if np.dtype(dtype).kind == "c" else np.dtype(dtype))
+    if return_host:
+        return w, v
     mat = DistributedMatrix.from_global(grid, v, (block_size, block_size))
-    return w.astype(v.real.dtype if np.dtype(dtype).kind == "c" else np.dtype(dtype)), mat
+    return w, mat

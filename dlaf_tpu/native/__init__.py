@@ -55,7 +55,7 @@ def _build() -> bool:
         if march is None:
             try:
                 with open("/proc/cpuinfo") as f:
-                    march = "x86-64-v3" if " avx2 " in f.read().replace("\t", " ") else "native"
+                    march = "x86-64-v3" if "avx2" in f.read().split() else "native"
             except OSError:
                 march = "native"
         for m in dict.fromkeys([march, "native"]):
@@ -98,6 +98,18 @@ def get_lib():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
+        try:
+            _register_symbols(lib)
+        except AttributeError:
+            # stale .so predating newer symbols (e.g. baked image whose mtime
+            # passes the freshness check but g++ is absent): fall back to the
+            # scipy paths rather than crash
+            return None
+        _lib = lib
+        return _lib
+
+
+def _register_symbols(lib):
         import ctypes as ct
 
         i64, ip = ct.c_int64, ct.POINTER
@@ -112,20 +124,32 @@ def get_lib():
             fn = getattr(lib, name)
             fn.restype = ct.c_int
             fn.argtypes = [i64, i64, ct.c_void_p, ip(rsc), ct.c_void_p, ct.c_void_p, ct.c_int]
-        for name in ("dlaf_band2trid_stream_d", "dlaf_band2trid_stream_z"):
+        for name, rsc in [
+            ("dlaf_band2trid_stream_d", ct.c_double),
+            ("dlaf_band2trid_stream_z", ct.c_double),
+            ("dlaf_band2trid_stream_s", ct.c_float),
+            ("dlaf_band2trid_stream_c", ct.c_float),
+        ]:
             fn = getattr(lib, name)
             fn.restype = ct.c_void_p
-            fn.argtypes = [i64, i64, ct.c_void_p, ip(ct.c_double), ct.c_void_p]
+            fn.argtypes = [i64, i64, ct.c_void_p, ip(rsc), ct.c_void_p]
         lib.dlaf_stream_size.restype = i64
         lib.dlaf_stream_size.argtypes = [ct.c_void_p]
-        for name in ("dlaf_stream_apply_d", "dlaf_stream_apply_z"):
+        for name in (
+            "dlaf_stream_apply_d",
+            "dlaf_stream_apply_z",
+            "dlaf_stream_apply_s",
+            "dlaf_stream_apply_c",
+        ):
             fn = getattr(lib, name)
             fn.restype = ct.c_int
             fn.argtypes = [ct.c_void_p, ct.c_void_p, i64, i64, ct.c_int]
         lib.dlaf_stream_free.restype = None
         lib.dlaf_stream_free.argtypes = [ct.c_void_p]
-        _lib = lib
-        return _lib
+        lib.dlaf_stream_export.restype = None
+        lib.dlaf_stream_export.argtypes = [
+            ct.c_void_p, ip(i64), ip(ct.c_double), ip(ct.c_double), ip(ct.c_double),
+        ]
 
 
 class RotationStream:
@@ -150,15 +174,36 @@ class RotationStream:
             raise ValueError(f"ev rows {ev.shape[0]} != n {self.n}")
         if nthreads <= 0:
             nthreads = min(os.cpu_count() or 1, 16)
-        fn = (
-            self._lib.dlaf_stream_apply_z
-            if np.dtype(self.dtype).kind == "c"
-            else self._lib.dlaf_stream_apply_d
-        )
+        fn = {
+            np.dtype(np.float64): self._lib.dlaf_stream_apply_d,
+            np.dtype(np.complex128): self._lib.dlaf_stream_apply_z,
+            np.dtype(np.float32): self._lib.dlaf_stream_apply_s,
+            np.dtype(np.complex64): self._lib.dlaf_stream_apply_c,
+        }[np.dtype(self.dtype)]
         rc = fn(self._h, ev.ctypes.data_as(ctypes.c_void_p), self.n, ev.shape[1], nthreads)
         if rc != 0:
             raise RuntimeError("stream apply failed")
         return ev
+
+    def export(self):
+        """Raw stream as numpy arrays ``(cols[int64], c, s)`` in recorded
+        order (application to E is the reverse order with G^H) — the input
+        for device-side blocked application."""
+        import numpy as np
+
+        r = len(self)
+        cols = np.zeros(r, np.int64)
+        c = np.zeros(r, np.float64)
+        s_re = np.zeros(r, np.float64)
+        s_im = np.zeros(r, np.float64)
+        p = ctypes.POINTER(ctypes.c_double)
+        self._lib.dlaf_stream_export(
+            self._h,
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            c.ctypes.data_as(p), s_re.ctypes.data_as(p), s_im.ctypes.data_as(p),
+        )
+        s = s_re if np.dtype(self.dtype).kind != "c" else (s_re + 1j * s_im)
+        return cols, c, s
 
     def close(self):
         if self._h is not None:
@@ -175,7 +220,8 @@ class RotationStream:
 def band2trid_stream(ab, band: int):
     """Reduce to tridiagonal retaining the rotation stream.  Returns
     (d, e, RotationStream) or None if the native library is unavailable.
-    f64/c128 only (the stream math is kept in double)."""
+    All four dtypes; the reduction runs in the input precision, the stream
+    coefficients are stored in double either way."""
     import numpy as np
 
     lib = get_lib()
@@ -183,23 +229,24 @@ def band2trid_stream(ab, band: int):
         return None
     ab = np.asfortranarray(ab)
     dt = ab.dtype
-    if dt not in (np.dtype(np.float64), np.dtype(np.complex128)):
+    fns = {
+        np.dtype(np.float64): (lib.dlaf_band2trid_stream_d, np.float64),
+        np.dtype(np.complex128): (lib.dlaf_band2trid_stream_z, np.float64),
+        np.dtype(np.float32): (lib.dlaf_band2trid_stream_s, np.float32),
+        np.dtype(np.complex64): (lib.dlaf_band2trid_stream_c, np.float32),
+    }
+    if dt not in fns:
         return None
+    fn, rdt = fns[dt]
     n = ab.shape[1]
-    d = np.zeros(n, np.float64)
+    d = np.zeros(n, rdt)
     e = np.zeros(max(n - 1, 0), dt)
-    if dt.kind == "c":
-        h = lib.dlaf_band2trid_stream_z(
-            n, band, ab.ctypes.data_as(ctypes.c_void_p),
-            d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            e.ctypes.data_as(ctypes.c_void_p),
-        )
-    else:
-        h = lib.dlaf_band2trid_stream_d(
-            n, band, ab.ctypes.data_as(ctypes.c_void_p),
-            d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            e.ctypes.data_as(ctypes.c_void_p),
-        )
+    rptr = ctypes.POINTER(ctypes.c_double if rdt == np.float64 else ctypes.c_float)
+    h = fn(
+        n, band, ab.ctypes.data_as(ctypes.c_void_p),
+        d.ctypes.data_as(rptr),
+        e.ctypes.data_as(ctypes.c_void_p),
+    )
     if not h:
         return None
     return d, e, RotationStream(h, n, dt, lib)

@@ -395,6 +395,24 @@ void* dlaf_band2trid_stream_z(int64_t n, int64_t b, void* ab, double* d,
   return s;
 }
 
+void* dlaf_band2trid_stream_s(int64_t n, int64_t b, float* ab, float* d,
+                              float* e) {
+  auto* s = new RotStream();
+  StreamRecorder<float> rec(s);
+  band2trid_acc<float>(n, b, ab, d, e, rec);
+  return s;
+}
+
+void* dlaf_band2trid_stream_c(int64_t n, int64_t b, void* ab, float* d,
+                              void* e) {
+  auto* s = new RotStream();
+  StreamRecorder<std::complex<float>> rec(s);
+  band2trid_acc<std::complex<float>>(
+      n, b, reinterpret_cast<std::complex<float>*>(ab), d,
+      reinterpret_cast<std::complex<float>*>(e), rec);
+  return s;
+}
+
 int64_t dlaf_stream_size(void* handle) {
   return int64_t(reinterpret_cast<RotStream*>(handle)->rots.size());
 }
@@ -412,8 +430,34 @@ int dlaf_stream_apply_z(void* handle, void* ev, int64_t n, int64_t k,
       reinterpret_cast<std::complex<double>*>(ev), n, k, nthreads);
 }
 
+int dlaf_stream_apply_s(void* handle, float* ev, int64_t n, int64_t k,
+                        int nthreads) {
+  return apply_stream<float>(*reinterpret_cast<RotStream*>(handle), ev, n, k,
+                             nthreads);
+}
+
+int dlaf_stream_apply_c(void* handle, void* ev, int64_t n, int64_t k,
+                        int nthreads) {
+  return apply_stream<std::complex<float>>(
+      *reinterpret_cast<RotStream*>(handle),
+      reinterpret_cast<std::complex<float>*>(ev), n, k, nthreads);
+}
+
 void dlaf_stream_free(void* handle) {
   delete reinterpret_cast<RotStream*>(handle);
+}
+
+// Export the raw stream (in recorded order) for device-side blocked
+// application: caller allocates arrays of dlaf_stream_size() entries.
+void dlaf_stream_export(void* handle, int64_t* cols, double* c, double* s_re,
+                        double* s_im) {
+  const auto& rots = reinterpret_cast<RotStream*>(handle)->rots;
+  for (size_t i = 0; i < rots.size(); ++i) {
+    cols[i] = rots[i].col;
+    c[i] = rots[i].c;
+    s_re[i] = rots[i].s_re;
+    s_im[i] = rots[i].s_im;
+  }
 }
 
 int dlaf_band2trid_d(int64_t n, int64_t b, double* ab, double* d, double* e,

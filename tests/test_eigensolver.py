@@ -195,7 +195,8 @@ def test_native_rotation_stream(grid_2x4):
     )
 
     m, nb = 16, 4
-    for dtype in [np.float64, np.complex128]:
+    for dtype in [np.float64, np.complex128, np.float32, np.complex64]:
+        tol = 1e-10 if np.dtype(dtype).name in ('float64', 'complex128') else 2e-4
         a = tu.random_hermitian_pd(m, dtype, seed=17)
         mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
         band_mat, _ = reduction_to_band(mat)
@@ -204,19 +205,32 @@ def test_native_rotation_stream(grid_2x4):
             pytest.skip("native library unavailable")
         d_, e_, phases, stream = st
         full = band_to_tridiagonal(band_mat)
-        np.testing.assert_allclose(np.sort(d_), np.sort(full.d), atol=1e-10)
+        np.testing.assert_allclose(np.sort(d_), np.sort(full.d), rtol=0, atol=tol)
         # both reductions must produce eigenvalue-identical tridiagonals
         trid_n = np.diag(d_) + np.diag(e_, 1) + np.diag(e_, -1)
         trid_f = np.diag(full.d) + np.diag(full.e, 1) + np.diag(full.e, -1)
         np.testing.assert_allclose(
-            np.linalg.eigvalsh(trid_n), np.linalg.eigvalsh(trid_f), atol=1e-10
+            np.linalg.eigvalsh(trid_n), np.linalg.eigvalsh(trid_f), atol=tol
         )
         # Q2 from the stream (applied to I) must be unitary and reduce the band
         q2 = stream.apply(phases[:, None] * np.eye(m, dtype=dtype))
-        np.testing.assert_allclose(q2.conj().T @ q2, np.eye(m), atol=1e-12)
+        np.testing.assert_allclose(q2.conj().T @ q2, np.eye(m), rtol=0, atol=tol)
         from dlaf_tpu.algorithms.band_to_tridiag import extract_band_host
 
         bfull = extract_band_host(band_mat, nb)
         np.testing.assert_allclose(
-            q2.conj().T @ bfull @ q2, trid_n, atol=1e-10
+            q2.conj().T @ bfull @ q2, trid_n, rtol=0, atol=tol * 20
         )
+        # export() must reproduce apply(): replay the raw stream in reverse
+        cols, c, s = stream.export()
+        assert cols.shape[0] == len(stream)
+        e_blk = tu.random_matrix(m, 3, dtype, seed=5)
+        want = stream.apply(e_blk)
+        got = np.array(e_blk, dtype=dtype)
+        for t_ in range(len(cols) - 1, -1, -1):
+            p = int(cols[t_])
+            cc, ss = c[t_], s[t_] if np.dtype(dtype).kind == "c" else s[t_].real
+            rp, rq = got[p].copy(), got[p + 1].copy()
+            got[p] = cc * rp - ss * rq
+            got[p + 1] = np.conj(ss) * rp + cc * rq
+        np.testing.assert_allclose(got, want, rtol=0, atol=tol)
