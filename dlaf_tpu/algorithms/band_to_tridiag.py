@@ -27,11 +27,14 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 class BandToTridiagResult:
     """d, e: real tridiagonal (diagonal / off-diagonal); q2: host (n x n)
     transformation with q2^H B q2 = tridiag (the reference returns the
-    equivalent compact HH reflector matrix)."""
+    equivalent compact HH reflector matrix); phases: the accumulated
+    subdiagonal phase factors rolled into q2's columns (identity for real
+    dtypes)."""
 
     d: np.ndarray
     e: np.ndarray
     q2: np.ndarray
+    phases: np.ndarray = None
 
 
 def extract_band_host(mat: DistributedMatrix, band: int) -> np.ndarray:
@@ -137,6 +140,32 @@ def band_to_tridiagonal(
     return _normalize_phases(d, e_raw, q, dt)
 
 
+def band_to_tridiagonal_hh(mat_band: DistributedMatrix, band: int | None = None):
+    """Householder-sweep band stage retaining the compact reflector set
+    (reference SweepWorker formulation, band_to_tridiag/mc.h:477-537).
+    Returns (d, e, phases, V[R, band], tau[R], band) — consumable by
+    bt_band_hh.bt_band_to_tridiagonal_hh's blocked device back-transform —
+    or None when the native library is unavailable.
+
+    ``e`` is real; for complex dtypes any residual subdiagonal phase (only
+    the final entry, which no sweep covers) is folded into ``phases``."""
+    from dlaf_tpu.native import band2trid_hh
+
+    if band is None:
+        band = mat_band.block_size.rows
+    dt = np.dtype(mat_band.dtype)
+    m = mat_band.size.rows
+    if m == 0:
+        return None
+    ab = extract_band_storage(mat_band, band)
+    out = band2trid_hh(ab, band)
+    if out is None:
+        return None
+    d, e_raw, v_refl, taus = out
+    norm = _normalize_phases(d, e_raw, None, dt)
+    return norm.d, norm.e, norm.phases, v_refl, taus, band
+
+
 def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = None):
     """Native-kernel variant that retains the compact rotation stream instead
     of materializing Q (the reference's compact-reflector strategy).  Returns
@@ -161,22 +190,15 @@ def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = N
         return None
     d, e_raw, stream = out
     norm = _normalize_phases(d, e_raw, None, dt)
-    if dt.kind == "c":
-        phases = np.ones(m, dtype=dt)
-        for j in range(m - 1):
-            ph = e_raw[j] / np.abs(e_raw[j]) if np.abs(e_raw[j]) > 0 else 1.0
-            phases[j + 1] = phases[j] * ph
-    else:
-        phases = np.ones(m, dtype=dt)
-    return norm.d, norm.e, phases, stream
+    return norm.d, norm.e, norm.phases, stream
 
 
 def _normalize_phases(d, e_raw, q, dt) -> BandToTridiagResult:
     """Roll subdiagonal phases into Q columns so (d, e) is real:
     (Q D)^H A (Q D) = real tridiag with D = diag of accumulated phases."""
     m = d.shape[0]
+    phases = np.ones(m, dtype=dt)
     if dt.kind == "c":
-        phases = np.ones(m, dtype=dt)
         for j in range(m - 1):
             ph = e_raw[j] / np.abs(e_raw[j]) if np.abs(e_raw[j]) > 0 else 1.0
             phases[j + 1] = phases[j] * ph
@@ -186,4 +208,4 @@ def _normalize_phases(d, e_raw, q, dt) -> BandToTridiagResult:
     else:
         e = np.real(e_raw).copy()
     rd = np.float32 if dt in (np.dtype(np.float32), np.dtype(np.complex64)) else np.float64
-    return BandToTridiagResult(np.asarray(d).astype(rd), np.asarray(e).astype(rd), q)
+    return BandToTridiagResult(np.asarray(d).astype(rd), np.asarray(e).astype(rd), q, phases)

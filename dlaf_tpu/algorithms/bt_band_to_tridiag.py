@@ -33,23 +33,3 @@ def bt_band_to_tridiagonal(
     return general_multiplication(t.NO_TRANS, t.NO_TRANS, 1.0, q2, mat_e, 0.0, out)
 
 
-def bt_band_to_tridiagonal_stream(
-    stream, phases, e_host: np.ndarray, grid, block_size
-) -> DistributedMatrix:
-    """E := Q2 E via the retained Givens rotation stream — the compact
-    back-transform (no N x N Q2 is ever materialized; the reference's
-    compact-reflector strategy, bt_band_to_tridiag/impl.h grouped applies).
-
-    Takes the tridiagonal eigenvector block on HOST (where the tridiagonal
-    solver produced it) and distributes only the final result — one upload,
-    no device round-trip.  The rotations act on rows of E; columns are
-    independent, so the apply is embarrassingly parallel over eigenvector
-    columns (threaded in the native kernel; across ranks each would apply to
-    its local columns)."""
-    dt = np.dtype(e_host.dtype)
-    if e_host.size == 0:
-        return DistributedMatrix.from_global(grid, e_host, block_size)
-    if dt.kind == "c":
-        e_host = phases[:, None] * e_host
-    out = stream.apply(e_host)
-    return DistributedMatrix.from_global(grid, out.astype(dt), block_size)

@@ -69,20 +69,20 @@ def hermitian_eigensolver(
     nb = mat_a.block_size.rows
     n = mat_a.size.rows
     band_mat, taus = reduction_to_band(mat_a)
-    # default band stage: native bulge chasing retaining the compact Givens
-    # rotation stream (O(N^2 b) reduction, no N x N Q2 anywhere) — the
-    # reference's compact-reflector strategy (bt_band_to_tridiag/impl.h);
-    # full AND partial spectra take this path
-    from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal_stream
-    from dlaf_tpu.algorithms.bt_band_to_tridiag import bt_band_to_tridiagonal_stream
+    # default band stage: native Householder bulge chasing (O(N^2 b)
+    # reduction, compact reflector set, no N x N Q2 anywhere) with the
+    # blocked compact-WY back-transform running as GEMMs on device — the
+    # reference's strategy (band_to_tridiag/mc.h SweepWorker +
+    # bt_band_to_tridiag/impl.h grouped applies); full AND partial spectra
+    from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal_hh
+    from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh
 
-    st = band_to_tridiagonal_stream(band_mat)
-    if st is not None:
-        d_, e_, phases, stream = st
+    hh = band_to_tridiagonal_hh(band_mat)
+    if hh is not None:
         evals, v_host = tridiagonal_eigensolver(
-            grid, d_, e_, nb, dtype=mat_a.dtype, spectrum=spectrum, return_host=True
+            grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum, return_host=True
         )
-        e = bt_band_to_tridiagonal_stream(stream, phases, v_host, grid, (nb, nb))
+        e = bt_band_to_tridiagonal_hh(hh, v_host, grid, (nb, nb))
         e = bt_reduction_to_band(e, band_mat, taus)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage

@@ -150,6 +150,22 @@ def _register_symbols(lib):
         lib.dlaf_stream_export.argtypes = [
             ct.c_void_p, ip(i64), ip(ct.c_double), ip(ct.c_double), ip(ct.c_double),
         ]
+        lib.dlaf_b2t_hh_count.restype = i64
+        lib.dlaf_b2t_hh_count.argtypes = [i64, i64]
+        for name, scalar in [
+            ("dlaf_band2trid_hh_d", ct.c_double),
+            ("dlaf_band2trid_hh_s", ct.c_float),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = ct.c_int
+            fn.argtypes = [i64, i64, ip(scalar), ip(scalar), ip(scalar), ip(scalar), ip(scalar), ct.c_int]
+        for name, rsc in [
+            ("dlaf_band2trid_hh_z", ct.c_double),
+            ("dlaf_band2trid_hh_c", ct.c_float),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = ct.c_int
+            fn.argtypes = [i64, i64, ct.c_void_p, ip(rsc), ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_int]
 
 
 class RotationStream:
@@ -250,6 +266,59 @@ def band2trid_stream(ab, band: int):
     if not h:
         return None
     return d, e, RotationStream(h, n, dt, lib)
+
+
+def band2trid_hh(ab, band: int, nthreads: int = 0):
+    """Householder-sweep band -> tridiagonal reduction (the reference
+    SweepWorker formulation, band_to_tridiag/mc.h:477-537).  Returns
+    (d, e, V, tau) with V of shape [R, band] holding reflector (sweep, step)
+    in slot order (sweep asc, step asc; v[0] = 1, zero-padded beyond its
+    length) and tau[R] — the compact transformation consumed by the blocked
+    WY back-transform.  Returns None if the native library is unavailable."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    ab = np.asfortranarray(ab)
+    dt = ab.dtype
+    names = {
+        np.dtype(np.float64): ("dlaf_band2trid_hh_d", np.float64),
+        np.dtype(np.float32): ("dlaf_band2trid_hh_s", np.float32),
+        np.dtype(np.complex128): ("dlaf_band2trid_hh_z", np.float64),
+        np.dtype(np.complex64): ("dlaf_band2trid_hh_c", np.float32),
+    }
+    if dt not in names:
+        return None
+    fname, rdt = names[dt]
+    n = ab.shape[1]
+    r_total = int(lib.dlaf_b2t_hh_count(n, band))
+    d = np.zeros(n, rdt)
+    e = np.zeros(max(n - 1, 0), dt)
+    # C writes v_out[i + slot*band]: a C-contiguous [R, band] array matches
+    v = np.zeros((r_total, max(band, 1)), dt)
+    tau = np.zeros(max(r_total, 1), dt)
+    fn = getattr(lib, fname)
+    c = ctypes
+    if nthreads <= 0:
+        nthreads = min(os.cpu_count() or 1, 16)
+    if dt.kind == "c":
+        rp = c.POINTER(c.c_double if rdt == np.float64 else c.c_float)
+        rc = fn(
+            n, band, ab.ctypes.data_as(c.c_void_p), d.ctypes.data_as(rp),
+            e.ctypes.data_as(c.c_void_p), v.ctypes.data_as(c.c_void_p),
+            tau.ctypes.data_as(c.c_void_p), nthreads,
+        )
+    else:
+        tp = c.POINTER(c.c_double if dt == np.float64 else c.c_float)
+        rc = fn(
+            n, band, ab.ctypes.data_as(tp), d.ctypes.data_as(tp),
+            e.ctypes.data_as(tp), v.ctypes.data_as(tp), tau.ctypes.data_as(tp),
+            nthreads,
+        )
+    if rc != 0:
+        return None
+    return d, e, v, tau[:r_total]
 
 
 def band2trid_native(ab, band: int, want_q: bool = True, nthreads: int = 0):

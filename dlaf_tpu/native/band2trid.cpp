@@ -16,6 +16,7 @@
 //
 // Exposed as extern "C" for ctypes (no pybind11 in this image).
 
+#include <atomic>
 #include <cmath>
 #include <complex>
 #include <cstdint>
@@ -373,9 +374,295 @@ int apply_stream(const RotStream& s, T* ev, int64_t n, int64_t k, int nthreads) 
   return 0;
 }
 
+// ---- Householder sweep variant ------------------------------------------
+// Same reduction (band -> tridiagonal) expressed as length-<=b Householder
+// reflectors instead of Givens rotations (the reference's SweepWorker
+// formulation, band_to_tridiag/mc.h:477-537: per step, two-sided Hermitian
+// apply on [j, j+n), right-apply to the m x n bulge block, new reflector
+// from the bulge's first column, left-apply to the remaining bulge columns).
+// Reflector (s, m) has head row 1 + s + m*b and length min(b, n - head);
+// it exists iff head <= n-2.  Storing reflectors (b values each + tau)
+// enables the BLOCKED back-transform: groups of g consecutive sweeps at one
+// chase level form a compact-WY factor applied to eigenvectors as GEMMs on
+// the accelerator (bt_band_to_tridiag/impl.h's grouped-apply capability).
+//
+// Working storage: column-major (2b+1) x n, W[off + j*ld] = A[j+off, j].
+
+template <class T>
+void larfg_(int64_t L, T* x, T& tau, T* v) {
+  // H = I - tau v v^H, H x = beta e1 (beta real), v[0] = 1.
+  using R = real_t<T>;
+  v[0] = T(1);
+  for (int64_t i = 1; i < L; ++i) v[i] = T(0);
+  if (L <= 1) {
+    tau = T(0);
+    return;
+  }
+  R xnorm2 = R(0);
+  for (int64_t i = 1; i < L; ++i) xnorm2 += abs2(x[i]);
+  T alpha = x[0];
+  R alphi;
+  if constexpr (std::is_same_v<T, std::complex<double>> ||
+                std::is_same_v<T, std::complex<float>>) {
+    alphi = alpha.imag();
+  } else {
+    alphi = R(0);
+  }
+  if (xnorm2 == R(0) && alphi == R(0)) {
+    tau = T(0);
+    return;
+  }
+  R alphr;
+  if constexpr (std::is_same_v<T, std::complex<double>> ||
+                std::is_same_v<T, std::complex<float>>) {
+    alphr = alpha.real();
+  } else {
+    alphr = alpha;
+  }
+  R beta = -std::copysign(std::sqrt(abs2(alpha) + xnorm2), alphr);
+  tau = (T(beta) - alpha) / T(beta);
+  T scale = T(1) / (alpha - T(beta));
+  for (int64_t i = 1; i < L; ++i) v[i] = scale * x[i];
+  x[0] = T(beta);
+  for (int64_t i = 1; i < L; ++i) x[i] = T(0);
+}
+
+template <class T>
+struct WBand {
+  T* w;
+  int64_t n, b, ld;  // ld = 2b+1
+  inline T& at(int64_t off, int64_t j) { return w[off + j * ld]; }  // A[j+off, j]
+  inline T full(int64_t r, int64_t c) {
+    if (r >= c) return at(r - c, c);
+    return conj_(at(c - r, r));
+  }
+  inline void full_set(int64_t r, int64_t c, T val) {
+    if (r >= c)
+      at(r - c, c) = val;
+    else
+      at(c - r, r) = conj_(val);
+  }
+};
+
+// A[j:j+nlen, j:j+nlen] <- H^H A H, H = I - tau v v^H.
+// larfg's H satisfies H^H x = beta e1, so the similarity uses H^H on the
+// left; the full transformation is then Q = H_1 H_2 ... H_R (taus
+// unconjugated in the back-transform's compact-WY accumulation).
+// her2k-style in-place form:  with w = A v, alpha = v^H w (real),
+// z = tau w - (|tau|^2 alpha / 2) v:   A' = A - z v^H - v z^H
+// (expand: A - conj(tau) v w^H - tau w v^H + |tau|^2 alpha v v^H) —
+// two passes over the stored lower triangle, no dense scratch.
+template <class T>
+void hh_two_sided(WBand<T>& A, int64_t j, int64_t nlen, const T* v, T tau,
+                  T* work) {
+  T* w = work;
+  for (int64_t r = 0; r < nlen; ++r) w[r] = T(0);
+  // w = A v over the stored lower triangle (and its conjugate mirror)
+  for (int64_t c = 0; c < nlen; ++c) {
+    const T vc = v[c];
+    T acc = T(0);  // accumulates conj(strict-lower column c) . v
+    T* colp = &A.at(0, j + c);
+    w[c] += colp[0] * vc;  // diagonal
+    for (int64_t r = c + 1; r < nlen; ++r) {
+      const T arc = colp[r - c];
+      w[r] += arc * vc;
+      acc += conj_(arc) * v[r];
+    }
+    w[c] += acc;
+  }
+  T alpha = T(0);
+  for (int64_t r = 0; r < nlen; ++r) alpha += conj_(v[r]) * w[r];
+  const T coeff = tau * conj_(tau) * alpha * T(real_t<T>(0.5));
+  for (int64_t r = 0; r < nlen; ++r) w[r] = tau * w[r] - coeff * v[r];
+  // A -= z v^H + v z^H on the stored lower triangle (z in w)
+  for (int64_t c = 0; c < nlen; ++c) {
+    const T cv = conj_(v[c]);
+    const T cz = conj_(w[c]);
+    T* colp = &A.at(0, j + c);
+    for (int64_t r = c; r < nlen; ++r) colp[r - c] -= w[r] * cv + v[r] * cz;
+  }
+}
+
+// rows [r0, r0+m) x cols [j, j+nlen): A <- A H (right apply)
+template <class T>
+void hh_right(WBand<T>& A, int64_t r0, int64_t m, int64_t j, int64_t nlen,
+              const T* v, T tau) {
+  for (int64_t r = r0; r < r0 + m; ++r) {
+    T z = T(0);
+    for (int64_t c = 0; c < nlen; ++c) z += A.at(r - (j + c), j + c) * v[c];
+    z *= tau;
+    for (int64_t c = 0; c < nlen; ++c) A.at(r - (j + c), j + c) -= z * conj_(v[c]);
+  }
+}
+
+// rows [r0, r0+m) x cols [c0, c0+w): A <- H^H A (left apply)
+template <class T>
+void hh_left(WBand<T>& A, int64_t r0, int64_t m, int64_t c0, int64_t w,
+             const T* v, T tau) {
+  T ct = conj_(tau);
+  for (int64_t c = c0; c < c0 + w; ++c) {
+    T z = T(0);
+    for (int64_t r = r0; r < r0 + m; ++r) z += conj_(v[r - r0]) * A.at(r - c, c);
+    z *= ct;
+    for (int64_t r = r0; r < r0 + m; ++r) A.at(r - c, c) -= z * v[r - r0];
+  }
+}
+
+int64_t b2t_hh_count(int64_t n, int64_t b) {
+  if (b <= 1 || n <= 2) return 0;
+  int64_t total = 0;
+  for (int64_t s = 0; s <= n - 3; ++s) total += (n - 3 - s) / b + 1;
+  return total;
+}
+
+// One full sweep s: reflector (s, 0) from column s's band tail, then chase.
+// Writes only slots [slot0, slot0 + count(s)) of v_out/tau_out and the band
+// region rows/cols [s, last]; iteration m touches rows/cols
+// [1+s+mb, s+mb+2b], so under pipelining it may run as soon as sweep s-1
+// has completed iteration m+2 (regions of (s-1, m') with m' >= m+3 start at
+// row s+mb+3b, strictly past this iteration's last row).
+template <class T>
+void run_sweep(WBand<T>& W, int64_t n, int64_t b, int64_t s, int64_t slot0,
+               T* v_out, T* tau_out, T* work, T* vcur,
+               std::atomic<int64_t>* progress) {
+  auto wait_prev = [&](int64_t m) {
+    if (s == 0) return;
+    const std::atomic<int64_t>& prev = progress[s - 1];
+    int64_t spins = 0;
+    while (prev.load(std::memory_order_acquire) < m + 3) {
+      if (++spins > 1024) {
+        std::this_thread::yield();
+        spins = 0;
+      }
+    }
+  };
+  int64_t slot = slot0;
+  int64_t j = s + 1;
+  int64_t L = std::min(b, n - j);
+  wait_prev(0);
+  T tau;
+  larfg_(L, &W.at(1, s), tau, vcur);
+  for (int64_t i = 0; i < b; ++i) v_out[i + slot * b] = i < L ? vcur[i] : T(0);
+  tau_out[slot] = tau;
+  ++slot;
+  int64_t m_it = 0;
+  while (true) {
+    int64_t nlen = std::min(b, n - j);
+    int64_t m = std::min(b, n - b - j);
+    hh_two_sided(W, j, nlen, vcur, tau, work);
+    if (m > 0) hh_right(W, j + nlen, m, j, nlen, vcur, tau);
+    if (m <= 1) break;
+    larfg_(m, &W.at(nlen, j), tau, vcur);
+    for (int64_t i = 0; i < b; ++i) v_out[i + slot * b] = i < m ? vcur[i] : T(0);
+    tau_out[slot] = tau;
+    ++slot;
+    hh_left(W, j + nlen, m, j + 1, nlen - 1, vcur, tau);
+    j += b;
+    ++m_it;
+    progress[s].store(m_it, std::memory_order_release);
+    wait_prev(m_it);
+  }
+  progress[s].store(int64_t(1) << 40, std::memory_order_release);  // done
+}
+
+// ab: (b+2) x n input band storage (only rows 0..b read); v_out: b x R
+// column-major (slot order: sweep asc, step asc), tau_out: R.
+// Sweeps are pipelined over worker threads (the reference's SweepWorker
+// task pipeline, band_to_tridiag/mc.h — here with an atomic progress array
+// enforcing the 3-step chase distance between consecutive sweeps).
+template <class T>
+int band2trid_hh(int64_t n, int64_t b, const T* ab, real_t<T>* d, T* e,
+                 T* v_out, T* tau_out, int nthreads) {
+  if (n <= 0) return 0;
+  const int64_t ld = 2 * b + 1;
+  std::vector<T> wbuf(size_t(ld) * size_t(n), T(0));
+  WBand<T> W{wbuf.data(), n, b, ld};
+  for (int64_t j = 0; j < n; ++j)
+    for (int64_t off = 0; off <= b && j + off < n; ++off)
+      W.at(off, j) = ab[off + j * (b + 2)];
+  if (b > 1 && n > 2) {
+    const int64_t nsweeps = n - 2;
+    std::vector<int64_t> slot0(nsweeps + 1, 0);
+    for (int64_t s = 0; s < nsweeps; ++s)
+      slot0[s + 1] = slot0[s] + ((n - 3 - s) / b + 1);
+    std::vector<std::atomic<int64_t>> progress(nsweeps);
+    for (auto& p : progress) p.store(0, std::memory_order_relaxed);
+    // pipeline depth: sweep s+1 trails sweep s by 3 chase steps, so at most
+    // ~(steps per sweep)/3 sweeps can be in flight — more threads only spin
+    const int64_t depth = std::max<int64_t>(1, (n / b + 2) / 3);
+    nthreads = std::max(
+        1, int(std::min<int64_t>(int64_t(nthreads), std::min<int64_t>(nsweeps, depth))));
+    if (nthreads == 1) {
+      std::vector<T> work(2 * b);
+      std::vector<T> vcur(b);
+      for (int64_t s = 0; s < nsweeps; ++s)
+        run_sweep(W, n, b, s, slot0[s], v_out, tau_out, work.data(),
+                  vcur.data(), progress.data());
+    } else {
+      std::atomic<int64_t> next{0};
+      std::vector<std::thread> ws;
+      for (int t = 0; t < nthreads; ++t) {
+        ws.emplace_back([&] {
+          std::vector<T> work(2 * b);
+          std::vector<T> vcur(b);
+          while (true) {
+            int64_t s = next.fetch_add(1, std::memory_order_relaxed);
+            if (s >= nsweeps) break;
+            run_sweep(W, n, b, s, slot0[s], v_out, tau_out, work.data(),
+                      vcur.data(), progress.data());
+          }
+        });
+      }
+      for (auto& w : ws) w.join();
+    }
+    if (slot0[nsweeps] != b2t_hh_count(n, b)) return -2;
+  }
+  for (int64_t j = 0; j < n; ++j) {
+    if constexpr (std::is_same_v<T, std::complex<double>> ||
+                  std::is_same_v<T, std::complex<float>>) {
+      d[j] = W.at(0, j).real();
+    } else {
+      d[j] = W.at(0, j);
+    }
+    if (j + 1 < n) e[j] = W.at(1, j);
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
+
+int64_t dlaf_b2t_hh_count(int64_t n, int64_t b) { return b2t_hh_count(n, b); }
+
+int dlaf_band2trid_hh_d(int64_t n, int64_t b, const double* ab, double* d,
+                        double* e, double* v_out, double* tau_out,
+                        int nthreads) {
+  return band2trid_hh<double>(n, b, ab, d, e, v_out, tau_out, nthreads);
+}
+
+int dlaf_band2trid_hh_s(int64_t n, int64_t b, const float* ab, float* d,
+                        float* e, float* v_out, float* tau_out, int nthreads) {
+  return band2trid_hh<float>(n, b, ab, d, e, v_out, tau_out, nthreads);
+}
+
+int dlaf_band2trid_hh_z(int64_t n, int64_t b, const void* ab, double* d,
+                        void* e, void* v_out, void* tau_out, int nthreads) {
+  return band2trid_hh<std::complex<double>>(
+      n, b, reinterpret_cast<const std::complex<double>*>(ab), d,
+      reinterpret_cast<std::complex<double>*>(e),
+      reinterpret_cast<std::complex<double>*>(v_out),
+      reinterpret_cast<std::complex<double>*>(tau_out), nthreads);
+}
+
+int dlaf_band2trid_hh_c(int64_t n, int64_t b, const void* ab, float* d,
+                        void* e, void* v_out, void* tau_out, int nthreads) {
+  return band2trid_hh<std::complex<float>>(
+      n, b, reinterpret_cast<const std::complex<float>*>(ab), d,
+      reinterpret_cast<std::complex<float>*>(e),
+      reinterpret_cast<std::complex<float>*>(v_out),
+      reinterpret_cast<std::complex<float>*>(tau_out), nthreads);
+}
 
 void* dlaf_band2trid_stream_d(int64_t n, int64_t b, double* ab, double* d,
                               double* e) {

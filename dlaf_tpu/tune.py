@@ -49,6 +49,9 @@ class TuneParameters:
     default_block_size: int = field(default_factory=lambda: _env("default_block_size", 256, int))
     eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
     bt_apply_group_size: int = field(default_factory=lambda: _env("bt_apply_group_size", 1, int))
+    bt_band_hh_group_size: int = field(
+        default_factory=lambda: _env("bt_band_hh_group_size", 128, int)
+    )
     tridiag_host_solver: str = field(default_factory=lambda: _env("tridiag_host_solver", "stemr", str))
     cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
     debug_dump_eigensolver_data: bool = field(
