@@ -108,7 +108,7 @@ def band_to_tridiagonal(
     the reference's compact-reflector strategy, bt_band_to_tridiag/impl.h.)
     """
     if band is None:
-        band = mat_band.block_size.rows
+        band = getattr(mat_band, "band_size", mat_band.block_size.rows)
     m = mat_band.size.rows
     dt = np.dtype(mat_band.dtype)
     if m == 0:
@@ -152,7 +152,7 @@ def band_to_tridiagonal_hh(mat_band: DistributedMatrix, band: int | None = None)
     from dlaf_tpu.native import band2trid_hh
 
     if band is None:
-        band = mat_band.block_size.rows
+        band = getattr(mat_band, "band_size", mat_band.block_size.rows)
     dt = np.dtype(mat_band.dtype)
     m = mat_band.size.rows
     if m == 0:
@@ -179,7 +179,7 @@ def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = N
     from dlaf_tpu.native import band2trid_stream
 
     if band is None:
-        band = mat_band.block_size.rows
+        band = getattr(mat_band, "band_size", mat_band.block_size.rows)
     dt = np.dtype(mat_band.dtype)
     m = mat_band.size.rows
     if m == 0:

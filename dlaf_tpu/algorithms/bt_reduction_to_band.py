@@ -28,7 +28,9 @@ from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 
-def _bt_r2b_kernel(a, taus, e, g_a: _spmd.Geometry, g_e: _spmd.Geometry, n_panels: int):
+def _bt_r2b_kernel(
+    a, taus, e, g_a: _spmd.Geometry, g_e: _spmd.Geometry, n_panels: int, band: int
+):
     a = coll.local(a)
     e = coll.local(e)
     taus = coll.local(taus)
@@ -38,28 +40,30 @@ def _bt_r2b_kernel(a, taus, e, g_a: _spmd.Geometry, g_e: _spmd.Geometry, n_panel
     rows = jnp.arange(np_)
 
     def body(s, e):
-        k = n_panels - 1 - s
-        kc = k % g_a.pc
-        lkc = k // g_a.pc
-        # 1. gather stored reflector column, rebuild V
+        p = n_panels - 1 - s
+        pb = p * band
+        kt = pb // g_a.nb
+        co = pb % g_a.nb
+        kc = kt % g_a.pc
+        lkc = kt // g_a.pc
+        # 1. gather stored reflector strip, rebuild V
         xc = _spmd.take_col(a, lkc, g_a)
-        gat = coll.all_gather_axis(xc, ROW_AXIS)
-        col = jnp.transpose(gat, (1, 0, 2, 3)).reshape(np_, g_a.nb)
-        col = coll.bcast(col.reshape(np_ // g_a.mb, g_a.mb, g_a.nb), kc, COL_AXIS).reshape(
-            np_, g_a.nb
-        )
-        start = (k + 1) * g_a.mb
-        j_idx = jnp.arange(g_a.nb)[None, :]
+        xcb = lax.dynamic_slice(xc, (0, 0, co), (g_a.ltr, g_a.mb, band))
+        gat = coll.all_gather_axis(xcb, ROW_AXIS)
+        col = jnp.transpose(gat, (1, 0, 2, 3)).reshape(np_ // g_a.mb, g_a.mb, band)
+        col = coll.bcast(col, kc, COL_AXIS).reshape(np_, band)
+        start = (p + 1) * band
+        j_idx = jnp.arange(band)[None, :]
         head = rows[:, None] == start + j_idx
         below = rows[:, None] > start + j_idx
         v = jnp.where(head, 1.0, jnp.where(below, col, 0.0)).astype(col.dtype)
-        tau_k = lax.dynamic_slice(taus, (k, 0), (1, g_a.nb))[0]
+        tau_k = lax.dynamic_slice(taus, (p, 0), (1, band))[0]
         # zero columns whose tau is 0 (incl. padding columns)
         v = jnp.where((tau_k == 0)[None, :], 0.0, v)
-        tmat = _t_factor(v, tau_k, g_a.nb)
+        tmat = _t_factor(v, tau_k, band)
         # 2. E -= V T (V^H E)
-        v_tiles = v.reshape(np_ // g_a.mb, g_a.mb, g_a.nb)
-        vr = jnp.take(v_tiles, gi, axis=0)  # [ltr, mb, nb]
+        v_tiles = v.reshape(np_ // g_a.mb, g_a.mb, band)
+        vr = jnp.take(v_tiles, gi, axis=0)  # [ltr, mb, band]
         w = coll.psum_axis(jnp.einsum("iab,ijac->jbc", vr.conj(), e), ROW_AXIS)
         tw = jnp.einsum("ab,jbc->jac", tmat, w)
         return e - jnp.einsum("iab,jbc->ijac", vr, tw)
@@ -81,15 +85,16 @@ def bt_reduction_to_band(
     if g_a.mb != g_e.mb or g_a.pr != g_e.pr or g_a.mt != g_e.mt:
         raise ValueError("bt_reduction_to_band: E row distribution must match A")
     n_panels = int(taus.shape[0])
+    band = int(taus.shape[1])
     if n_panels == 0 or g_e.nt == 0:
         return mat_e
-    # taus replicated: stack to [Pr, Pc, n_panels, nb]
+    # taus replicated: stack to [Pr, Pc, n_panels, band]
     taus_stacked = jnp.broadcast_to(
         taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
     )
     taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
-    key = (mat_e.grid.cache_key, g_a, g_e, n_panels)
+    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band)
     if key not in _cache:
-        kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels)
+        kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
     return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))

@@ -27,7 +27,7 @@ from dlaf_tpu.algorithms.bt_band_to_tridiag import bt_band_to_tridiagonal
 from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
 from dlaf_tpu.algorithms.cholesky import cholesky_factorization
 from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
-from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+from dlaf_tpu.algorithms.reduction_to_band import get_band_size, reduction_to_band
 from dlaf_tpu.algorithms.triangular_solver import triangular_solver
 from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
 from dlaf_tpu.matrix import util as mutil
@@ -68,7 +68,8 @@ def hermitian_eigensolver(
         return _eigh_single_device(mat_a, spectrum)
     nb = mat_a.block_size.rows
     n = mat_a.size.rows
-    band_mat, taus = reduction_to_band(mat_a)
+    band = get_band_size(nb)
+    band_mat, taus = reduction_to_band(mat_a, band=band)
     # default band stage: native Householder bulge chasing (O(N^2 b)
     # reduction, compact reflector set, no N x N Q2 anywhere) with the
     # blocked compact-WY back-transform running as GEMMs on device — the
@@ -77,7 +78,7 @@ def hermitian_eigensolver(
     from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal_hh
     from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh
 
-    hh = band_to_tridiagonal_hh(band_mat)
+    hh = band_to_tridiagonal_hh(band_mat, band=band)
     if hh is not None:
         evals, v_host = tridiagonal_eigensolver(
             grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum, return_host=True
@@ -86,7 +87,7 @@ def hermitian_eigensolver(
         e = bt_reduction_to_band(e, band_mat, taus)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage
-    b2t = band_to_tridiagonal(band_mat)
+    b2t = band_to_tridiagonal(band_mat, band=band)
     evals, e_tri = tridiagonal_eigensolver(
         grid, b2t.d, b2t.e, nb, dtype=mat_a.dtype, spectrum=spectrum
     )
@@ -145,8 +146,9 @@ def hermitian_eigenvalues(
         # single-device: XLA eigvalsh directly
         res = _eigh_single_device(mat_a, spectrum)
         return res.eigenvalues
-    band_mat, _ = reduction_to_band(mat_a)
-    b2t = band_to_tridiagonal(band_mat, want_q=False)
+    band = get_band_size(mat_a.block_size.rows)
+    band_mat, _ = reduction_to_band(mat_a, band=band)
+    b2t = band_to_tridiagonal(band_mat, band=band, want_q=False)
     if b2t.d.shape[0] == 0:
         return b2t.d
     if spectrum is None:

@@ -1,4 +1,4 @@
-"""Reduction of a Hermitian matrix to band form (band = tile size).
+"""Reduction of a Hermitian matrix to band form (band <= tile size).
 
 TPU-native re-design of the reference reduction_to_band
 (reference: include/dlaf/eigensolver/reduction_to_band.h:51-120 and
@@ -8,10 +8,11 @@ two-sided updates with p2p reductions.  Here, per panel k (one jitted
 fori_loop-free outer Python loop is avoided — everything is ONE jitted SPMD
 fori_loop over panels):
 
-  1. the panel column (tile col k, rows k+1:) is all-gathered along 'r' and
-     broadcast along 'c' so EVERY rank holds the full N x nb panel; the nb
-     Householder reflectors are then computed redundantly everywhere
-     (O(N nb^2) flops, vectorized over rows — replaces the reference's
+  1. the band-wide panel strip (cols [p*band, (p+1)*band), rows below
+     (p+1)*band — generally NOT tile-aligned) is all-gathered along 'r' and
+     broadcast along 'c' so EVERY rank holds the full N x band panel; the
+     band Householder reflectors are then computed redundantly everywhere
+     (O(N band^2) flops, vectorized over rows — replaces the reference's
      nworkers+barriers panel tasks, impl.h:578-700),
   2. the compact-WY T factor is the nb x nb triangular inverse
      T = inv(diag(1/tau) + striu(V^H V)) (replaces computeTFactor,
@@ -102,46 +103,50 @@ def _t_factor(v, taus, nb: int):
     return jnp.where((taus == 0)[None, :], 0, tmat)
 
 
-def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
+def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int, band: int):
     x = coll.local(x)
     myr, myc = coll.my_rank()
-    gi = _spmd.local_row_tiles(g, myr)
     np_ = g.ltr * g.pr * g.mb  # padded global rows
     mt_pad = np_ // g.mb
-    taus_all = jnp.zeros((n_panels, g.nb), x.dtype)
+    taus_all = jnp.zeros((n_panels, band), x.dtype)
 
-    def body(k, carry, L, C):
+    def body(p, carry, L, C):
         x, taus_all = carry
-        kc = k % g.pc
-        lkc = k // g.pc
-        # 1. gather panel column to every rank (full height: O(N nb) data)
+        pb = p * band  # first panel column (global element)
+        kt = pb // g.nb  # tile column holding the panel
+        co = pb % g.nb  # column offset inside that tile
+        kc = kt % g.pc
+        lkc = kt // g.pc
+        # 1. gather the band-wide panel strip to every rank (O(N band) data)
         xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
-        gat = coll.all_gather_axis(xc, ROW_AXIS)  # [pr, ltr, mb, nb]
-        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, g.nb)
+        xcb = lax.dynamic_slice(xc, (0, 0, co), (g.ltr, g.mb, band))
+        gat = coll.all_gather_axis(xcb, ROW_AXIS)  # [pr, ltr, mb, band]
+        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, band)
         col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
-        p = col_tiles.reshape(np_, g.nb)
-        start = (k + 1) * g.mb
-        p_out, v, taus = _hh_panel(p, start, g.nb, np_, g.m)
-        taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (k, 0))
+        pnl = col_tiles.reshape(np_, band)
+        start = (p + 1) * band  # first eliminated row
+        p_out, v, taus = _hh_panel(pnl, start, band, np_, g.m)
+        taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (p, 0))
         # 2. T factor (replicated)
-        tmat = _t_factor(v, taus, g.nb)
+        tmat = _t_factor(v, taus, band)
         # 3. two-sided trailing update on the bucketed window (static L x C):
         # V is zero outside the trailing region, so clamped window overlap
         # contributes nothing — same safety argument as cholesky bucketing
-        v_tiles = v.reshape(mt_pad, g.mb, g.nb)
-        rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(
-            jnp.asarray(k).dtype
+        v_tiles = v.reshape(mt_pad, g.mb, band)
+        t0 = start // g.mb  # first tile row/col with reflector data
+        rs = jnp.clip((t0 + g.pr - 1 - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(
+            jnp.asarray(p).dtype
         )
-        cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(
-            jnp.asarray(k).dtype
+        cs = jnp.clip((t0 + g.pc - 1 - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(
+            jnp.asarray(p).dtype
         )
         gi_w = (rs + jnp.arange(L)) * g.pr + myr
         gj_w = (cs + jnp.arange(C)) * g.pc + myc
-        vr = jnp.take(v_tiles, gi_w, axis=0)  # [L, mb, nb] (gi_w < mt_pad)
+        vr = jnp.take(v_tiles, gi_w, axis=0)  # [L, mb, band] (gi_w < mt_pad)
         valid_c = (gj_w < mt_pad)[:, None, None]
         vc = jnp.where(
             valid_c, jnp.take(v_tiles, jnp.clip(gj_w, 0, mt_pad - 1), axis=0), 0
-        )  # [C, mb, nb]
+        )  # [C, mb, band]
         xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
         xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
         xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
@@ -149,7 +154,7 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
         mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
         mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
         w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
-        # mask W2 to the trailing region (element rows >= (k+1)*mb)
+        # mask W2 to the trailing region (element rows >= start)
         ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
         w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
         w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
@@ -159,20 +164,26 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
             - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
         )
         x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
-        # 4. write the factored panel column back (tiles below the diagonal)
-        p_tiles = p_out.reshape(mt_pad, g.mb, g.nb)
-        newcol = jnp.take(p_tiles, gi, axis=0)
-        below = (gi > k)[:, None, None]
+        # 4. write the factored panel strip back (element rows >= start on
+        # the owning tile column; start is generally NOT tile-aligned)
+        p_tiles = p_out.reshape(mt_pad, g.mb, band)
+        gi = _spmd.local_row_tiles(g, myr)
+        newcol_b = jnp.take(p_tiles, gi, axis=0)  # [ltr, mb, band]
+        ge_rows = gi[:, None] * g.mb + jnp.arange(g.mb)[None, :]
+        write = (ge_rows >= start)[:, :, None] & (myc == kc)
         xc_now = _spmd.take_col(x, lkc, g)
-        newcol = jnp.where(below & (myc == kc), newcol, xc_now)
-        x = _spmd.put_col(x, newcol, lkc)
+        cur_b = lax.dynamic_slice(xc_now, (0, 0, co), (g.ltr, g.mb, band))
+        new_b = jnp.where(write, newcol_b, cur_b)
+        xc_new = lax.dynamic_update_slice(xc_now, new_b, (0, 0, co))
+        x = _spmd.put_col(x, xc_new, lkc)
         return x, taus_all
 
     carry = (x, taus_all)
-    for k0, k1 in _spmd.halving_segments(n_panels):
-        L = max(min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1), 1)
-        C = max(min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1), 1)
-        carry = lax.fori_loop(k0, k1, partial(body, L=L, C=C), carry)
+    for p0, p1 in _spmd.halving_segments(n_panels):
+        t0 = (p0 + 1) * band // g.mb
+        L = max(min(g.ltr, (g.mt - 1 - t0 + g.pr - 1) // g.pr + 1), 1)
+        C = max(min(g.ltc, (g.mt - 1 - t0 + g.pc - 1) // g.pc + 1), 1)
+        carry = lax.fori_loop(p0, p1, partial(body, L=L, C=C), carry)
     x, taus_all = carry
     return coll.relocal(x), coll.relocal(taus_all)
 
@@ -180,26 +191,47 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int):
 _cache = {}
 
 
-def reduction_to_band(mat_a: DistributedMatrix) -> Tuple[DistributedMatrix, jax.Array]:
-    """Reduce Hermitian ``mat_a`` (``uplo='L'`` storage) to band form with
-    band size = tile size.  Returns (matrix holding band + reflector tails in
-    the lower triangle, taus[n_panels, nb]).
+def get_band_size(nb: int) -> int:
+    """Band size used by the eigensolver: the smallest divisor of nb not
+    below ``eigensolver_min_band`` — nb itself when nb is already small
+    (reference: eigensolver/internal/get_band_size.h:20).  A band smaller
+    than the tile decouples the O(N^2 b) host bulge-chasing cost from the
+    MXU-shaped tile size."""
+    from dlaf_tpu.tune import get_tune_parameters
 
-    The reference supports band sizes dividing nb (get_band_size.h);
-    this implementation fixes band == nb — the natural TPU choice since the
-    tile is the MXU work unit.
+    b_min = max(2, int(get_tune_parameters().eigensolver_min_band))
+    for div in range(nb // b_min, 1, -1):
+        if nb % div == 0:
+            return nb // div
+    return nb
+
+
+def reduction_to_band(
+    mat_a: DistributedMatrix, band: int | None = None
+) -> Tuple[DistributedMatrix, jax.Array]:
+    """Reduce Hermitian ``mat_a`` (``uplo='L'`` storage) to band form with
+    band size ``band`` (default: tile size; must divide the tile size —
+    reference get_band_size.h).  Returns (matrix holding band + reflector
+    tails in the lower triangle, taus[n_panels, band]); the band size is
+    recoverable as ``taus.shape[1]``.
     """
     if mat_a.size.rows != mat_a.size.cols or mat_a.block_size.rows != mat_a.block_size.cols:
         raise ValueError("reduction_to_band: square matrix with square tiles required")
     g = _spmd.Geometry.of(mat_a.dist)
-    n_panels = max(g.mt - 1, 0)
+    if band is None:
+        band = g.nb
+    if band < 1 or g.nb % band:
+        raise ValueError(f"reduction_to_band: band {band} must divide the tile size {g.nb}")
+    n_panels = max(0, (g.m - 1) // band)
     full = mutil.hermitize(mat_a, "L")
     if n_panels == 0:
-        return full, jnp.zeros((0, g.nb), mat_a.dtype)
-    key = (mat_a.grid.cache_key, g)
+        return full, jnp.zeros((0, band), mat_a.dtype)
+    key = (mat_a.grid.cache_key, g, band)
     if key not in _cache:
-        kern = partial(_red2band_kernel, g=g, n_panels=n_panels)
+        kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
     data, taus_stack = _cache[key](full.data)
     full.data = data  # the hermitized copy was donated
-    return mat_a.like(data), taus_stack[0, 0]
+    out = mat_a.like(data)
+    out.band_size = band  # consumed as the default by band_to_tridiagonal*
+    return out, taus_stack[0, 0]

@@ -33,8 +33,9 @@ class TuneParameters:
     - ``default_block_size``: tile size used when callers don't specify one
       (reference block sizes come from the user's ScaLAPACK descriptor).
       256 keeps tiles MXU-shaped (multiples of 128 preferred on TPU).
-    - ``eigensolver_min_band``: kept for interface parity; band == tile size
-      in this implementation (reference tune.h:126).
+    - ``eigensolver_min_band``: lower bound used by get_band_size to pick
+      the eigensolver band (smallest divisor of nb >= this; reference
+      tune.h:126, get_band_size.h:20) — e.g. nb=256 yields band=128.
     - ``bt_apply_group_size``: panels applied per back-transform fori_loop
       step (reference bt_band_to_tridiag_hh_apply_group_size, tune.h:105).
     - ``tridiag_host_solver``: 'stemr' (MRRR) or 'stedc'-style host driver

@@ -76,3 +76,55 @@ def test_reduction_single_tile(grid_2x4):
     mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (4, 4))
     out, taus = reduction_to_band(mat)
     assert taus.shape[0] == 0
+
+
+def test_reduction_to_band_sub_band(grid_2x4):
+    """band < nb (reference get_band_size.h): eigenvalues preserved, band
+    structure honored, Q1 back-transform consistent."""
+    from dlaf_tpu.algorithms.band_to_tridiag import extract_band_host
+    from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
+
+    for dtype, n, nb, band in [
+        (np.float64, 96, 16, 4),
+        (np.complex128, 64, 16, 8),
+        (np.float64, 37, 8, 4),
+    ]:
+        a = tu.random_hermitian_pd(n, dtype, seed=n + band)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+        band_mat, taus = reduction_to_band(mat, band=band)
+        assert taus.shape[1] == band
+        bfull = extract_band_host(band_mat, band)
+        np.testing.assert_allclose(
+            np.linalg.eigvalsh(bfull), np.linalg.eigvalsh(a), rtol=0, atol=1e-9
+        )
+        e = DistributedMatrix.from_global(grid_2x4, np.eye(n, dtype=dtype), (nb, nb))
+        q1 = bt_reduction_to_band(e, band_mat, taus).to_global()
+        full = np.tril(a) + np.tril(a, -1).conj().T
+        np.testing.assert_allclose(
+            q1.conj().T @ q1, np.eye(n), rtol=0, atol=1e-10
+        )
+        np.testing.assert_allclose(
+            q1.conj().T @ full @ q1, bfull, rtol=0, atol=1e-9
+        )
+
+
+def test_heev_sub_band(grid_2x4):
+    """Full HEEV pipeline with band < nb via eigensolver_min_band."""
+    from dlaf_tpu import tune
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+
+    saved = tune.get_tune_parameters().eigensolver_min_band
+    tune.get_tune_parameters().update(eigensolver_min_band=4)
+    try:
+        a = tu.random_hermitian_pd(96, np.float64, seed=44)
+        mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (16, 16))
+        res = hermitian_eigensolver("L", mat, backend="pipeline")
+        v = res.eigenvectors.to_global()
+        np.testing.assert_allclose(
+            res.eigenvalues, np.linalg.eigvalsh(a), rtol=0, atol=1e-10
+        )
+        resid = np.max(np.abs(a @ v - v * res.eigenvalues[None, :]))
+        orth = np.max(np.abs(v.conj().T @ v - np.eye(96)))
+        assert resid < 1e-10 * np.abs(a).max() * 96 and orth < 1e-11, (resid, orth)
+    finally:
+        tune.get_tune_parameters().update(eigensolver_min_band=saved)
