@@ -87,35 +87,46 @@ def _build_factors(v_refl, taus, groups, w, g, b, dtype):
 _apply_cache = {}
 
 
-def _apply_fn(n_pad, k, w, g, G, dtype, dist_key=None, dist=None, sharding=None):
-    """Jitted grouped-WY application (+ optional pack to stacked layout)."""
+def _wy_group_loop(e_pad, V_all, tau_all, offs, w, g, G, k):
+    """Apply the G grouped compact-WY factors to the k-column block ``e_pad``
+    (the shared core of the host-input and distributed back-transforms).
+
+    T^{-1} = diag(1/tau) + triu(V^H V, 1)  (larft forward/columnwise)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    key = (n_pad, k, w, g, G, np.dtype(dtype), dist_key)
+    if G == 0:
+        return e_pad
+    M = jnp.einsum("gwi,gwj->gij", V_all.conj(), V_all)
+    eye = jnp.eye(g, dtype=V_all.dtype)
+    tinv = jnp.triu(M, 1) + eye[None] / tau_all[:, None, :]
+    T_all = jax.scipy.linalg.solve_triangular(
+        tinv, jnp.broadcast_to(eye, tinv.shape), lower=False
+    )
+
+    def body(i, e):
+        off = offs[i]
+        ew = lax.dynamic_slice(e, (off, jnp.zeros((), off.dtype)), (w, k))
+        x = V_all[i].conj().T @ ew
+        ew = ew - V_all[i] @ (T_all[i] @ x)
+        return lax.dynamic_update_slice(e, ew, (off, jnp.zeros((), off.dtype)))
+
+    return lax.fori_loop(0, G, body, e_pad)
+
+
+def _apply_fn(n_pad, k, w, g, G, dtype, dist_key=None, dist=None, sharding=None, prec="float32"):
+    """Jitted grouped-WY application (+ optional pack to stacked layout)."""
+    import jax
+
+    key = (n_pad, k, w, g, G, np.dtype(dtype), dist_key, prec)
     if key in _apply_cache:
         return _apply_cache[key]
 
     from dlaf_tpu.matrix import layout
 
     def run(e_pad, V_all, tau_all, offs):
-        # T^{-1} = diag(1/tau) + triu(V^H V, 1)  (larft forward/columnwise)
-        M = jnp.einsum("gwi,gwj->gij", V_all.conj(), V_all)
-        eye = jnp.eye(g, dtype=V_all.dtype)
-        tinv = jnp.triu(M, 1) + eye[None] / tau_all[:, None, :]
-        T_all = jax.scipy.linalg.solve_triangular(
-            tinv, jnp.broadcast_to(eye, tinv.shape), lower=False
-        )
-
-        def body(i, e):
-            off = offs[i]
-            ew = lax.dynamic_slice(e, (off, jnp.zeros((), off.dtype)), (w, k))
-            x = V_all[i].conj().T @ ew
-            ew = ew - V_all[i] @ (T_all[i] @ x)
-            return lax.dynamic_update_slice(e, ew, (off, jnp.zeros((), off.dtype)))
-
-        e_pad = lax.fori_loop(0, G, body, e_pad)
+        e_pad = _wy_group_loop(e_pad, V_all, tau_all, offs, w, g, G, k)
         if dist is None:
             return e_pad
         eg = e_pad[: dist.size.rows, :]
@@ -124,6 +135,98 @@ def _apply_fn(n_pad, k, w, g, G, dtype, dist_key=None, dist=None, sharding=None)
     fn = jax.jit(run, out_shardings=sharding) if sharding is not None else jax.jit(run)
     _apply_cache[key] = fn
     return fn
+
+
+_dist_cache = {}
+
+
+def bt_band_to_tridiagonal_hh_dist(
+    hh, mat_e: DistributedMatrix, group_size: int | None = None
+) -> DistributedMatrix:
+    """E := Q2 E with E ALREADY DISTRIBUTED (block-cyclic stacked layout).
+
+    The rotations act on E's rows and E's columns are independent, so the
+    group loop is communication-free under a column-sharded layout: the
+    stacked block-cyclic E is resharded to column panels over the flat device
+    order (one XLA all-to-all), every device applies the full WY group
+    schedule to its ``k/P`` columns locally, and the result is resharded back
+    (second all-to-all).  This replaces the reference's p2p exchange of E
+    rows (bt_band_to_tridiag/impl.h distributed path) with two cheap
+    relayouts — the TPU-native choice, since XLA owns layout transforms.
+    No O(n x k) host or replicated array is ever formed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+    from dlaf_tpu.matrix import layout
+
+    from dlaf_tpu.tune import get_tune_parameters
+
+    d, e_, phases, v_refl, taus, band = hh
+    grid = mat_e.grid
+    dist = mat_e.dist
+    n, k = dist.size
+    dt = np.dtype(mat_e.dtype)
+    if group_size is None:
+        group_size = get_tune_parameters().bt_band_hh_group_size
+    has_refl = v_refl.shape[0] > 0 and n > 2 and k > 0 and band > 1
+    if has_refl:
+        g = max(1, min(group_size, band, n - 2))
+        groups, w = hh_schedule(n, band, g)
+        V_all, tau_all, offs = _build_factors(v_refl, taus, groups, w, g, band, dt)
+        G = len(groups)
+    else:
+        if dt.kind != "c" or n == 0 or k == 0:
+            return mat_e
+        g, w, G = 1, 1, 0
+        V_all = np.zeros((0, 1, 1), dt)
+        tau_all = np.ones((0, 1), dt)
+        offs = np.zeros(0, np.int32)
+    n_pad = max(n, w)
+    Ptot = grid.grid_size.count()
+    kloc = -(-k // Ptot)
+    kpad = kloc * Ptot
+    mesh = grid.mesh
+    colspec = P(None, (ROW_AXIS, COL_AXIS))
+    ph = np.ones(n_pad, dt)
+    if dt.kind == "c":
+        ph[:n] = phases.astype(dt)
+    prec = get_tune_parameters().eigensolver_matmul_precision
+    key = (grid.cache_key, dist, n_pad, kpad, w, g, G, dt, prec)
+    if key not in _dist_cache:
+
+        def loop(va, ta, of, e_loc):
+            return _wy_group_loop(e_loc, va, ta, of, w, g, G, kloc)
+
+        sm = jax.shard_map(
+            loop,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), colspec),
+            out_specs=colspec,
+            check_vma=False,
+        )
+
+        def run(x, va, ta, of, phj):
+            gg = layout.unpad_global(layout.unpack(x, dist), dist)
+            gp = jnp.pad(gg, ((0, n_pad - n), (0, kpad - k)))
+            gp = phj[:, None] * gp
+            gp = jax.lax.with_sharding_constraint(gp, NamedSharding(mesh, colspec))
+            gp = sm(va, ta, of, gp)
+            return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
+
+        _dist_cache[key] = jax.jit(
+            run, out_shardings=grid.stacked_sharding(), donate_argnums=(0,)
+        )
+    with jax.default_matmul_precision(prec):
+        data = _dist_cache[key](
+            mat_e.data,
+            jnp.asarray(V_all),
+            jnp.asarray(tau_all),
+            jnp.asarray(offs),
+            jnp.asarray(ph),
+        )
+    return mat_e._inplace(data)
 
 
 def bt_band_to_tridiagonal_hh(
@@ -146,9 +249,9 @@ def bt_band_to_tridiagonal_hh(
         e_host = phases[:, None] * e_host
     if v_refl.shape[0] == 0 or n == 0 or k == 0:
         return DistributedMatrix.from_global(grid, e_host, block_size)
-    if group_size is None:
-        from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters
 
+    if group_size is None:
         group_size = get_tune_parameters().bt_band_hh_group_size
     g = max(1, min(group_size, band, n - 2))
     groups, w = hh_schedule(n, band, g)
@@ -157,9 +260,12 @@ def bt_band_to_tridiagonal_hh(
     e_pad = e_host if n_pad == n else np.pad(e_host, ((0, n_pad - n), (0, 0)))
 
     dist = Distribution(Size2D(n, k), Size2D(*block_size), grid.grid_size, Index2D(0, 0))
+    prec = get_tune_parameters().eigensolver_matmul_precision
     fn = _apply_fn(
         n_pad, k, w, g, len(groups), dt,
         dist_key=(grid.cache_key, dist), dist=dist, sharding=grid.stacked_sharding(),
+        prec=prec,
     )
-    data = fn(jnp.asarray(e_pad), jnp.asarray(V_all), jnp.asarray(tau_all), jnp.asarray(offs))
+    with jax.default_matmul_precision(prec):
+        data = fn(jnp.asarray(e_pad), jnp.asarray(V_all), jnp.asarray(tau_all), jnp.asarray(offs))
     return DistributedMatrix(dist, grid, data)

@@ -93,8 +93,12 @@ def bt_reduction_to_band(
         taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
     )
     taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
-    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band)
+    from dlaf_tpu.tune import get_tune_parameters
+
+    prec = get_tune_parameters().eigensolver_matmul_precision
+    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec)
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
-    return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))
+    with jax.default_matmul_precision(prec):
+        return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))

@@ -8,8 +8,8 @@ reference):
 
   reduction_to_band  (distributed, device)         impl.h:85
   band_to_tridiagonal (host, like the reference's CPU-only stage) impl.h:87
-  tridiagonal_eigensolver (host MRRR for now)      impl.h:89
-  bt_band_to_tridiagonal (distributed GEMM)        impl.h:94
+  tridiagonal_eigensolver (distributed on-device D&C) impl.h:89
+  bt_band_to_tridiagonal (distributed WY groups)   impl.h:94
   bt_reduction_to_band (distributed WY applies)    impl.h:95
 
 Partial spectrum via eigenvalue index range (MatrixRef col-slice in the
@@ -74,16 +74,19 @@ def hermitian_eigensolver(
     # reduction, compact reflector set, no N x N Q2 anywhere) with the
     # blocked compact-WY back-transform running as GEMMs on device — the
     # reference's strategy (band_to_tridiag/mc.h SweepWorker +
-    # bt_band_to_tridiag/impl.h grouped applies); full AND partial spectra
+    # bt_band_to_tridiag/impl.h grouped applies); full AND partial spectra.
+    # The tridiagonal stage defaults to the multi-level distributed D&C and
+    # its eigenvector matrix stays DISTRIBUTED through both back-transforms
+    # — no O(N^2) host object on this path.
     from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal_hh
-    from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh
+    from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh_dist
 
     hh = band_to_tridiagonal_hh(band_mat, band=band)
     if hh is not None:
-        evals, v_host = tridiagonal_eigensolver(
-            grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum, return_host=True
+        evals, v = tridiagonal_eigensolver(
+            grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
         )
-        e = bt_band_to_tridiagonal_hh(hh, v_host, grid, (nb, nb))
+        e = bt_band_to_tridiagonal_hh_dist(hh, v)
         e = bt_reduction_to_band(e, band_mat, taus)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage

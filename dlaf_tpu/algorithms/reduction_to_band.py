@@ -226,11 +226,15 @@ def reduction_to_band(
     full = mutil.hermitize(mat_a, "L")
     if n_panels == 0:
         return full, jnp.zeros((0, band), mat_a.dtype)
-    key = (mat_a.grid.cache_key, g, band)
+    from dlaf_tpu.tune import get_tune_parameters
+
+    prec = get_tune_parameters().eigensolver_matmul_precision
+    key = (mat_a.grid.cache_key, g, band, prec)
     if key not in _cache:
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
-    data, taus_stack = _cache[key](full.data)
+    with jax.default_matmul_precision(prec):
+        data, taus_stack = _cache[key](full.data)
     full.data = data  # the hermitized copy was donated
     out = mat_a.like(data)
     out.band_size = band  # consumed as the default by band_to_tridiagonal*

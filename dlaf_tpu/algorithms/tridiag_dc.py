@@ -16,11 +16,13 @@ SURVEY.md §7 M5d:
     dlaed3 trick), then ONE GEMM per merge for the basis update — where the
     flops are, hence MXU,
   * deflation of zero-coupling entries handled by masking (z_i ~ 0 keeps
-    (d_i, e_i) as an eigenpair); close-pole deflation is handled by the
-    shifted secular representation rather than index compaction (static
-    shapes).  Heavily clustered spectra may lose some orthogonality vs
-    LAPACK's full deflation; the host MRRR backend remains the default
-    until the distributed version lands (round 2).
+    (d_i, e_i) as an eigenpair); close poles are rotated together by the
+    scan-based Givens deflation (_pole_deflate).
+
+The multi-level DISTRIBUTED solver (the default backend) lives in
+tridiag_dc_dist.py; this module remains the single-device reference
+implementation (backend='dc') and the home of the scan-based merge used by
+its tests.
 
 The merge math: T = blockdiag(T1', T2') + beta*v v^T with
 T1'[last,last] -= beta, T2'[first,first] -= beta, v = [e_last; e_first];
@@ -265,59 +267,6 @@ def _dc_solve(d, e, leaf: int):
         lam_cur = jnp.stack(new_lam)
         q_cur = jnp.stack(new_q)
     return lam_cur[0], q_cur[0]
-
-
-def tridiag_dc_distributed(grid, d, e, block_size: int, dtype=np.float64):
-    """Distributed Cuppen D&C: split at a tile boundary near n/2, solve the
-    halves with the on-device solver, then perform ONE distributed merge —
-    replicated secular solve + deflation, eigenvector assembly as a
-    distributed GEMM over the grid (the reference's mergeDistSubproblems
-    structure, tridiag_solver/merge.h:1810: rank-1 solve on workers,
-    assembly via distributed multiplication).
-
-    Returns (lam ascending [host], eigenvector DistributedMatrix)."""
-    import scipy.linalg as _sla
-
-    from dlaf_tpu.algorithms.multiplication import general_multiplication
-    from dlaf_tpu.matrix.matrix import DistributedMatrix
-
-    rdt = np.float32 if np.dtype(dtype) in (np.dtype(np.float32), np.dtype(np.complex64)) else np.float64
-    d = np.asarray(d, rdt)
-    e = np.asarray(e, rdt)
-    n = d.shape[0]
-    if n <= 2 * block_size or grid.grid_size.count() == 1:
-        w, v = tridiag_dc(d, e)
-        mat = DistributedMatrix.from_global(grid, np.asarray(v, rdt), (block_size, block_size))
-        return np.asarray(w), mat
-    # tile-aligned split near the middle
-    m = (n // 2 // block_size) * block_size or block_size
-    beta = e[m - 1]
-    d1 = d[:m].copy()
-    d2 = d[m:].copy()
-    d1[-1] -= abs(beta)
-    d2[0] -= abs(beta)
-    l1, q1 = tridiag_dc(d1, e[: m - 1])
-    l2, q2 = tridiag_dc(d2, e[m:])
-    l1, q1 = np.asarray(l1), np.asarray(q1)
-    l2, q2 = np.asarray(l2), np.asarray(q2)
-    s = np.sign(beta) if beta != 0 else 1.0
-    dd = np.concatenate([l1, l2])
-    z = np.concatenate([q1[-1, :], s * q2[0, :]])
-    rho = abs(float(beta))
-    if rho == 0:
-        order = np.argsort(dd)
-        qq = _sla.block_diag(q1, q2)[:, order]
-        return dd[order], DistributedMatrix.from_global(grid, qq, (block_size, block_size))
-    deflate_tol = 8.0 * np.finfo(rdt).eps
-    lam, b, order = _merge_eigh(dd, z, rho, deflate_tol)
-    lam, b, order = np.asarray(lam), np.asarray(b), np.asarray(order)
-    # distributed assembly: Q = blockdiag(Q1, Q2)[:, order] @ B
-    qq = _sla.block_diag(q1, q2)[:, order]
-    mat_qq = DistributedMatrix.from_global(grid, qq.astype(rdt), (block_size, block_size))
-    mat_b = DistributedMatrix.from_global(grid, b.astype(rdt), (block_size, block_size))
-    out = DistributedMatrix.zeros(grid, (n, n), (block_size, block_size), rdt)
-    res = general_multiplication("N", "N", 1.0, mat_qq, mat_b, 0.0, out)
-    return lam, res
 
 
 def tridiag_dc(d, e, leaf: int = 32):

@@ -11,6 +11,12 @@ GEMMs on device.  A JAX-native D&C (deflation via masked sorts, vectorized
 secular-equation Newton solve) is the planned replacement
 (SURVEY.md §7 M5d).
 
+Round-2 update: the DEFAULT backend is the multi-level distributed
+on-device Cuppen D&C (``dc_dist``, tridiag_dc_dist.py) — the reference's
+distributed algorithm re-designed for the mesh (merge.h:1810
+mergeDistSubproblems); host MRRR (``host``) and the single-device jitted
+D&C (``dc``) remain selectable.
+
 Supports the reference's partial-spectrum interface (eigenvalue index
 range), eigensolver/eigensolver.h:39 partial spectrum overloads.
 """
@@ -32,7 +38,7 @@ def tridiagonal_eigensolver(
     block_size: int,
     dtype=np.float64,
     spectrum: Optional[Tuple[int, int]] = None,
-    backend: str = "host",
+    backend: str = "dc_dist",
     return_host: bool = False,
 ) -> Tuple[np.ndarray, DistributedMatrix]:
     """Eigendecomposition of the real symmetric tridiagonal (d, e).
@@ -44,9 +50,9 @@ def tridiagonal_eigensolver(
     eigenvector block as a host ndarray instead (for callers that apply a
     host-side transform next, avoiding a device round-trip).
 
-    Backends: 'host' = LAPACK MRRR via scipy; 'dc' = on-device Cuppen
-    divide & conquer (tridiag_dc.py — the reference's algorithm, vectorized
-    secular solve + GEMM merges on the accelerator)."""
+    Backends: 'dc_dist' (default) = multi-level distributed on-device Cuppen
+    D&C (tridiag_dc_dist.py); 'host' = LAPACK MRRR via scipy; 'dc' =
+    single-device on-device Cuppen D&C (tridiag_dc.py)."""
     n = d.shape[0]
     if n == 0:
         w = np.zeros(0, np.dtype(dtype))
@@ -55,22 +61,13 @@ def tridiagonal_eigensolver(
         mat = DistributedMatrix.zeros(grid, (0, 0), (block_size, block_size), dtype)
         return w, mat
     if backend == "dc_dist":
-        from dlaf_tpu.algorithms.tridiag_dc import tridiag_dc_distributed
+        from dlaf_tpu.algorithms.tridiag_dc_dist import tridiag_dc_distributed
 
-        w, mat = tridiag_dc_distributed(grid, d, e, block_size, dtype=dtype)
-        if spectrum is not None:
-            il, iu = spectrum
-            w = w[il : iu + 1]
-            v = mat.to_global()[:, il : iu + 1].astype(np.dtype(dtype))
-            if return_host:
-                return w, v
-            return w, DistributedMatrix.from_global(grid, v, (block_size, block_size))
+        w, mat = tridiag_dc_distributed(
+            grid, d, e, block_size, dtype=dtype, spectrum=spectrum
+        )
         if return_host:
             return w, mat.to_global().astype(np.dtype(dtype))
-        if np.dtype(dtype).kind == "c":
-            mat = DistributedMatrix.from_global(
-                grid, mat.to_global().astype(np.dtype(dtype)), (block_size, block_size)
-            )
         return w, mat
     if backend == "dc":
         from dlaf_tpu.algorithms.tridiag_dc import tridiag_dc

@@ -40,6 +40,16 @@ class TuneParameters:
       step (reference bt_band_to_tridiag_hh_apply_group_size, tune.h:105).
     - ``tridiag_host_solver``: 'stemr' (MRRR) or 'stedc'-style host driver
       for the tridiagonal stage.
+    - ``dc_leaf_size``: target leaf-block size for the distributed D&C
+      tridiagonal solver (rounded to a tile multiple; subproblem sizes are
+      this times powers of two).
+    - ``eigensolver_matmul_precision``: JAX matmul precision for the
+      eigensolver pipeline stages ('float32' | 'bfloat16_3x' | 'bfloat16').
+      TPU MXU f32 matmuls default to bf16 passes (eps ~8e-3), which would
+      destroy eigenvector orthogonality; the eigensolver traces its kernels
+      under full-f32 precision by default.  General BLAS-style ops (GEMM,
+      POTRF, TRSM) follow JAX's global default so throughput-focused users
+      keep the fast path.
     - ``cholesky_lookahead``: use the lookahead SPMD kernel (panel k+1
       overlapped with the bulk trailing update — benefits multi-chip
       meshes; the bucketed kernel is the single-chip default).
@@ -54,6 +64,10 @@ class TuneParameters:
         default_factory=lambda: _env("bt_band_hh_group_size", 128, int)
     )
     tridiag_host_solver: str = field(default_factory=lambda: _env("tridiag_host_solver", "stemr", str))
+    dc_leaf_size: int = field(default_factory=lambda: _env("dc_leaf_size", 512, int))
+    eigensolver_matmul_precision: str = field(
+        default_factory=lambda: _env("eigensolver_matmul_precision", "float32", str)
+    )
     cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
     debug_dump_eigensolver_data: bool = field(
         default_factory=lambda: _env("debug_dump_eigensolver_data", False, bool)
