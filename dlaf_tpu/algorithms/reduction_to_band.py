@@ -48,21 +48,39 @@ from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 
+def _panel_block_size(nb: int) -> int:
+    """Largest divisor of nb not above 32 — the inner sub-panel width.
+    Bands whose divisors <= 32 are all tiny (e.g. primes > 32) fall back to
+    one full-width block: unrolling nb/bs sub-panels each with its own
+    T factor would cost more than the single sequential loop."""
+    bs = min(32, nb)
+    while nb % bs:
+        bs -= 1
+    return bs if bs >= 8 or bs == nb else nb
+
+
 def _hh_panel(p, start_row, nb: int, np_: int, m: int):
     """Householder QR of the gathered panel ``p[np_, nb]``; active rows are
     ``start_row + j`` and below for column j, rows >= m are padding.
+
+    Blocked (reference: the recursive larft idea of
+    factorization/qr/t_factor_impl.h): the sequential rank-1 loop only ever
+    touches a bs<=32-wide sub-panel; each completed sub-panel is applied to
+    the remaining panel columns as ONE compact-WY GEMM update
+    ``P -= V (T^H (V^H P))``, so the bandwidth-bound sequential work drops
+    from O(np_*nb) to O(np_*bs) per step and the aggregation rides the MXU.
 
     Returns (p_out, v, taus): p_out has R on/above the reflector diagonal and
     v tails below (LAPACK layout); v[np_, nb] is the explicit V with unit
     heads; taus[nb]."""
     rows = jnp.arange(np_)
     rdtype = jnp.zeros((), p.dtype).real.dtype
+    bs = _panel_block_size(nb)
 
-    def body(j, carry):
-        p, v, taus = carry
-        s = start_row + j
-        x = p[:, j]
-        active = (rows >= s) & (rows < m)
+    def col_body(jj, carry, j0):
+        sp, v, taus = carry  # sp: [np_, bs] current sub-panel
+        s = start_row + j0 + jj
+        x = sp[:, jj]
         tail = (rows > s) & (rows < m)
         alpha = jnp.sum(jnp.where(rows == s, x, 0))
         tail_sq = jnp.sum(jnp.where(tail, jnp.abs(x) ** 2, 0)).astype(rdtype)
@@ -75,20 +93,40 @@ def _hh_panel(p, start_row, nb: int, np_: int, m: int):
         vj = jnp.where(tail, x / denom, 0) + jnp.where(
             (rows == s) & nonzero, 1.0, 0.0
         ).astype(p.dtype)
-        # apply H_j^H to the remaining columns: P -= conj(tau) v (v^H P)
-        w = jnp.einsum("i,ik->k", vj.conj(), p)
-        colmask = jnp.arange(nb) > j
-        p = p - jnp.conj(tau) * jnp.einsum("i,k->ik", vj, jnp.where(colmask, w, 0))
+        # apply H_j^H to the remaining sub-panel columns:
+        # SP -= conj(tau) v (v^H SP)
+        w = jnp.einsum("i,ik->k", vj.conj(), sp)
+        colmask = jnp.arange(bs) > jj
+        sp = sp - jnp.conj(tau) * jnp.einsum("i,k->ik", vj, jnp.where(colmask, w, 0))
         # store the factored column: R above, beta at s, v tail below
         newcol = jnp.where(rows == s, beta, jnp.where(tail, vj, x))
-        p = jnp.where((jnp.arange(nb) == j)[None, :], newcol[:, None], p)
-        v = v.at[:, j].set(vj)
-        taus = taus.at[j].set(tau)
-        return p, v, taus
+        sp = jnp.where((jnp.arange(bs) == jj)[None, :], newcol[:, None], sp)
+        v = v.at[:, jj].set(vj)
+        taus = taus.at[jj].set(tau)
+        return sp, v, taus
 
-    v0 = jnp.zeros((np_, nb), p.dtype)
-    t0 = jnp.zeros((nb,), p.dtype)
-    return lax.fori_loop(0, nb, body, (p, v0, t0))
+    v_parts, tau_parts = [], []
+    for j0 in range(0, nb, bs):
+        sp = lax.slice_in_dim(p, j0, j0 + bs, axis=1)
+        v0 = jnp.zeros((np_, bs), p.dtype)
+        t0 = jnp.zeros((bs,), p.dtype)
+        sp, v_sub, taus_sub = lax.fori_loop(
+            0, bs, partial(col_body, j0=j0), (sp, v0, t0)
+        )
+        p = lax.dynamic_update_slice(p, sp, (0, j0))
+        if j0 + bs < nb:
+            # aggregated block apply of Q_sub^H = I - V T^H V^H to the
+            # not-yet-factored panel columns
+            tsub = _t_factor(v_sub, taus_sub, bs)
+            trail = lax.slice_in_dim(p, j0 + bs, nb, axis=1)
+            w = jnp.einsum("ia,ik->ak", v_sub.conj(), trail)
+            trail = trail - jnp.einsum("ia,ba,bk->ik", v_sub, tsub.conj(), w)
+            p = lax.dynamic_update_slice(p, trail, (0, j0 + bs))
+        v_parts.append(v_sub)
+        tau_parts.append(taus_sub)
+    v = v_parts[0] if len(v_parts) == 1 else jnp.concatenate(v_parts, axis=1)
+    taus = tau_parts[0] if len(tau_parts) == 1 else jnp.concatenate(tau_parts)
+    return p, v, taus
 
 
 def _t_factor(v, taus, nb: int):

@@ -593,7 +593,8 @@ def tridiag_dc_distributed(
     pad_vals = big * (2.0 + np.arange(n_pad - n, dtype=rdt) / max(1, n_pad))
     d_mod = np.concatenate([d, pad_vals])
     e_pad = np.zeros(n_pad, rdt)
-    e_pad[: n - 1] = e[: n - 1] if e.shape[0] >= n - 1 else e
+    ne = min(e.shape[0], n - 1)
+    e_pad[:ne] = e[:ne]
     nleaf = n_pad // s0
     for mth in range(s0, n_pad, s0):
         beta = abs(e_pad[mth - 1])

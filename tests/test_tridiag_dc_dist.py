@@ -52,11 +52,11 @@ def _check(grid, d, e, nb, dtype, tol_factor=150):
     assert np.dtype(mat.dtype) == np.dtype(dtype)
 
 
+@pytest.mark.parametrize("leaf_size", [32], indirect=True)
 @pytest.mark.parametrize("n,nb", [(96, 16), (100, 16), (64, 16)])
 def test_dc_dist_grids(comm_grids, leaf_size, n, nb):
     rng = np.random.default_rng(5)
     d, e = _random_tridiag(rng, n)
-    get_tune_parameters().dc_leaf_size = 32
     for grid in comm_grids:
         _check(grid, d, e, nb, np.float64)
 
