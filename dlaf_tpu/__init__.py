@@ -16,8 +16,10 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 from dlaf_tpu.algorithms.cholesky import cholesky_factorization
 from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.ref import MatrixRef
 from dlaf_tpu.algorithms.multiplication import (
     general_multiplication,
+    general_sub_multiplication,
     hermitian_multiplication,
     triangular_multiplication,
 )
@@ -48,9 +50,11 @@ __all__ = [
     "Size2D",
     "Distribution",
     "DistributedMatrix",
+    "MatrixRef",
     "cholesky_factorization",
     "triangular_solver",
     "general_multiplication",
+    "general_sub_multiplication",
     "hermitian_multiplication",
     "triangular_multiplication",
     "inverse_from_cholesky_factor",

@@ -352,6 +352,196 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
     return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
+def _sub_gemm_kernel(
+    a, b, c, g_a, g_b, g_c,
+    ai0, ak0, bk0, bj0, ci0, cj0,  # tile origins of the three views
+    Ri, Rj, Rk,  # view extents in tiles
+    L, Cw,  # static C-window sizes (local row/col slots)
+    alpha, beta,
+):
+    """C[view] := alpha A[view] B[view] + beta C[view], all views tile-index
+    ranges into full stacked matrices (reference: GeneralSub::callNN,
+    multiplication/general/api.h:28, generalized to independent per-operand
+    origins a la MatrixRef).  Tiles outside the C view are untouched.
+
+    Row alignment: when (ai0 - ci0) % pr == 0 the A-panel tiles this rank
+    needs are locally owned (taken by index); otherwise the panel is
+    all-gathered along 'r' first.  Mirrored for B along 'c'."""
+    a, b, c = coll.local(a), coll.local(b), coll.local(c)
+    myr, myc = coll.my_rank()
+    al = jnp.asarray(alpha, c.dtype)
+    pr, pc = g_c.pr, g_c.pc
+    aligned_r = (ai0 - ci0) % pr == 0
+    aligned_c = (bj0 - cj0) % pc == 0
+
+    # C window: first local row slot with global tile >= ci0 (clipped so the
+    # static window fits; out-of-range tiles are masked)
+    rs = jnp.clip((ci0 + pr - 1 - myr) // pr, 0, max(g_c.ltr - L, 0))
+    cs = jnp.clip((cj0 + pc - 1 - myc) // pc, 0, max(g_c.ltc - Cw, 0))
+    gi_w = (rs + jnp.arange(L)) * pr + myr  # global C row tiles in window
+    gj_w = (cs + jnp.arange(Cw)) * pc + myc
+    rel_i = gi_w - ci0  # row index within the view
+    rel_j = gj_w - cj0
+    valid_i = (rel_i >= 0) & (rel_i < Ri)
+    valid_j = (rel_j >= 0) & (rel_j < Rj)
+
+    def body(k, acc):
+        # --- A panel: tiles A[ai0 + rel_i, ak0 + k], broadcast along 'c'
+        gka = ak0 + k
+        ac = _spmd.take_col(a, gka // pc, g_a)  # [ltr_a, mb, nb]
+        ac = coll.psum_axis(
+            jnp.where(myc == gka % pc, ac, jnp.zeros_like(ac)), COL_AXIS
+        )
+        if aligned_r:
+            la = jnp.clip((ai0 + rel_i) // pr, 0, g_a.ltr - 1)
+            ap = jnp.take(ac, la, axis=0)
+        else:
+            # gather only the Lg-slot window covering rows [ai0, ai0+Ri):
+            # per-source-rank slot starts are static (ai0, Ri are)
+            Lg = min(g_a.ltr, -(-Ri // pr) + 1)
+            sA = jnp.asarray(
+                [min(max((ai0 + pr - 1 - r) // pr, 0), g_a.ltr - Lg) for r in range(pr)]
+            )
+            my_s = sA[myr]
+            zz = jnp.asarray(0, my_s.dtype)
+            acw = lax.dynamic_slice(ac, (my_s, zz, zz), (Lg, g_a.mb, g_a.nb))
+            gat = coll.all_gather_axis(acw, ROW_AXIS)  # [pr, Lg, mb, nb]
+            flat = gat.reshape(pr * Lg, g_a.mb, g_a.nb)
+            gt = ai0 + rel_i
+            r_idx = gt % pr
+            s_idx = gt // pr - sA[r_idx]
+            ap = jnp.take(flat, jnp.clip(r_idx * Lg + s_idx, 0, pr * Lg - 1), axis=0)
+        ap = jnp.where(valid_i[:, None, None], ap, jnp.zeros_like(ap))
+        # --- B panel: tiles B[bk0 + k, bj0 + rel_j], broadcast along 'r'
+        gkb = bk0 + k
+        br = _spmd.take_row(b, gkb // pr, g_b)  # [ltc_b, mb, nb]
+        br = coll.psum_axis(
+            jnp.where(myr == gkb % pr, br, jnp.zeros_like(br)), ROW_AXIS
+        )
+        if aligned_c:
+            lb = jnp.clip((bj0 + rel_j) // pc, 0, g_b.ltc - 1)
+            bp = jnp.take(br, lb, axis=0)
+        else:
+            Lg = min(g_b.ltc, -(-Rj // pc) + 1)
+            sB = jnp.asarray(
+                [min(max((bj0 + pc - 1 - q) // pc, 0), g_b.ltc - Lg) for q in range(pc)]
+            )
+            my_s = sB[myc]
+            zz = jnp.asarray(0, my_s.dtype)
+            brw = lax.dynamic_slice(br, (my_s, zz, zz), (Lg, g_b.mb, g_b.nb))
+            gat = coll.all_gather_axis(brw, COL_AXIS)  # [pc, Lg, mb, nb]
+            flat = gat.reshape(pc * Lg, g_b.mb, g_b.nb)
+            gt = bj0 + rel_j
+            q_idx = gt % pc
+            s_idx = gt // pc - sB[q_idx]
+            bp = jnp.take(flat, jnp.clip(q_idx * Lg + s_idx, 0, pc * Lg - 1), axis=0)
+        bp = jnp.where(valid_j[:, None, None], bp, jnp.zeros_like(bp))
+        return acc + jnp.einsum("iab,jbc->ijac", ap, bp)
+
+    acc = lax.fori_loop(
+        0, Rk, body, jnp.zeros((L, Cw, g_c.mb, g_c.nb), c.dtype)
+    )
+    zero = jnp.asarray(0, rs.dtype)
+    cw = lax.dynamic_slice(c, (rs, cs, zero, zero), (L, Cw, g_c.mb, g_c.nb))
+    valid = (valid_i[:, None] & valid_j[None, :])[:, :, None, None]
+    cw = jnp.where(valid, jnp.asarray(beta, c.dtype) * cw + al * acc, cw)
+    c = lax.dynamic_update_slice(c, cw, (rs, cs, zero, zero))
+    return coll.relocal(c)
+
+
+def general_sub_multiplication(
+    alpha, a_ref, b_ref, beta, c_ref
+) -> DistributedMatrix:
+    """C_view := alpha A_view B_view + beta C_view over tile-aligned
+    sub-matrix views; tiles of C outside the view are untouched (reference:
+    internal::GeneralSub::callNN, multiplication/general/api.h:28 — there
+    one square diagonal tile range; here independent MatrixRef windows,
+    matrix/matrix_ref.h:39).  Operands may be DistributedMatrix (whole) or
+    MatrixRef.  Returns C's parent with the window updated (functional
+    in-place; the parent's buffer is donated)."""
+    from dlaf_tpu.matrix.ref import as_ref
+
+    a_ref, b_ref, c_ref = as_ref(a_ref), as_ref(b_ref), as_ref(c_ref)
+    mb, nb = c_ref.block_size
+    for r in (a_ref, b_ref):
+        if tuple(r.block_size) != (mb, nb):
+            raise ValueError("general_sub_multiplication: block sizes must match")
+    if not (a_ref.grid is c_ref.grid and b_ref.grid is c_ref.grid):
+        raise ValueError("general_sub_multiplication: all operands on one grid")
+    M, K = a_ref.size
+    K2, N = b_ref.size
+    if (M, N) != tuple(c_ref.size) or K != K2:
+        raise ValueError(
+            f"sub-gemm: A {M}x{K} B {K2}x{N} C {tuple(c_ref.size)}"
+        )
+    mat_a, mat_b, mat_c = a_ref.parent, b_ref.parent, c_ref.parent
+    g_a = _spmd.Geometry.of(mat_a.dist)
+    g_b = _spmd.Geometry.of(mat_b.dist)
+    g_c = _spmd.Geometry.of(mat_c.dist)
+    Ri, Rj = c_ref.nr_tiles
+    Rk = a_ref.nr_tiles.cols
+    if Ri == 0 or Rj == 0:
+        return mat_c
+    if mat_c.grid.grid_size.count() == 1:
+        return _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref)
+    L = min(g_c.ltr, -(-Ri // g_c.pr))
+    Cw = min(g_c.ltc, -(-Rj // g_c.pc))
+    origins = (
+        a_ref.tile_origin.row, a_ref.tile_origin.col,
+        b_ref.tile_origin.row, b_ref.tile_origin.col,
+        c_ref.tile_origin.row, c_ref.tile_origin.col,
+    )
+    # A/B windows may live in C's parent (the canonical MatrixRef use:
+    # updating one window of a matrix from another) — donating C's buffer
+    # would then alias a live operand, so compile a non-donating variant
+    aliased = (mat_a.data is mat_c.data) or (mat_b.data is mat_c.data)
+    key = (
+        "subgemm", mat_c.grid.cache_key, complex(alpha), complex(beta),
+        origins, Ri, Rj, Rk, g_a, g_b, g_c, aliased,
+    )
+    if key not in _cache:
+        kern = partial(
+            _sub_gemm_kernel, g_a=g_a, g_b=g_b, g_c=g_c,
+            ai0=origins[0], ak0=origins[1], bk0=origins[2], bj0=origins[3],
+            ci0=origins[4], cj0=origins[5], Ri=Ri, Rj=Rj, Rk=Rk, L=L, Cw=Cw,
+            alpha=alpha, beta=beta,
+        )
+        _cache[key] = coll.spmd(
+            mat_c.grid, kern, donate_argnums=() if aliased else (2,)
+        )
+    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+
+
+def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
+    """1x1-grid fast path: slice the three global windows, one dense GEMM."""
+    import jax
+
+    da, db, dc = a_ref.parent.dist, b_ref.parent.dist, c_ref.parent.dist
+    oa, ob, oc = tuple(a_ref.origin), tuple(b_ref.origin), tuple(c_ref.origin)
+    sa, sb, sc = tuple(a_ref.size), tuple(b_ref.size), tuple(c_ref.size)
+    key = ("sublocal", da, db, dc, oa, ob, oc, sa, sb, sc,
+           np.dtype(c_ref.dtype), complex(alpha), complex(beta))
+    if key not in _local_cache:
+        from dlaf_tpu.matrix import layout
+
+        @jax.jit
+        def run(xa, xb, xc):
+            ga = layout.unpad_global(layout.unpack(xa, da), da)
+            gb = layout.unpad_global(layout.unpack(xb, db), db)
+            gc = layout.unpad_global(layout.unpack(xc, dc), dc)
+            aw = ga[oa[0] : oa[0] + sa[0], oa[1] : oa[1] + sa[1]]
+            bw = gb[ob[0] : ob[0] + sb[0], ob[1] : ob[1] + sb[1]]
+            cw = gc[oc[0] : oc[0] + sc[0], oc[1] : oc[1] + sc[1]]
+            new = jnp.asarray(alpha, gc.dtype) * (aw @ bw) + jnp.asarray(beta, gc.dtype) * cw
+            gc = lax.dynamic_update_slice(gc, new.astype(gc.dtype), oc)
+            return layout.pack(layout.pad_global(gc, dc), dc)
+
+        _local_cache[key] = run
+    return c_ref.parent._inplace(
+        _local_cache[key](a_ref.parent.data, b_ref.parent.data, c_ref.parent.data)
+    )
+
+
 def _check_mult_shapes(opa, opb, mat_a, mat_b, mat_c):
     am, an = mat_a.size
     if opa != t.NO_TRANS:

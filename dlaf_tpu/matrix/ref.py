@@ -1,0 +1,112 @@
+"""Non-copying tile-aligned sub-matrix views.
+
+TPU-native analogue of ``dlaf::matrix::MatrixRef``
+(reference: include/dlaf/matrix/matrix_ref.h:39 — a sub-matrix view sharing
+the parent's tile storage).  A ``MatrixRef`` records a tile-aligned window
+into a ``DistributedMatrix`` WITHOUT copying: consuming algorithms (e.g.
+``general_sub_multiplication``) read the parent's stacked block-cyclic
+device buffer directly and restrict their tile loops/windows to the view,
+so no ``to_global``/``from_global`` or re-pack round-trip happens.
+
+Unlike the reference (which hands out aliasing tile pipelines), JAX arrays
+are immutable — a ref is therefore a *read* view plus a write-back window
+description; algorithms that "write through" a ref return the updated
+parent buffer (functional in-place, same as every other algorithm here).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dlaf_tpu.common.index import Index2D, Size2D
+from dlaf_tpu.matrix.distribution import Distribution
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+@dataclass(frozen=True)
+class MatrixRef:
+    """A tile-aligned rectangular window of ``parent``.
+
+    ``origin`` is the element offset (must be tile-aligned); ``size`` the
+    element extent.  The extent must either be a multiple of the tile size
+    or reach the parent's edge in that dimension (interior partial tiles
+    would break the shared tiling — same constraint as the reference's
+    tile-grid-aligned sub-matrices, matrix_ref.h:39).
+    """
+
+    parent: DistributedMatrix
+    origin: Index2D
+    size: Size2D
+
+    def __init__(self, parent: DistributedMatrix, origin, size):
+        origin = Index2D(*(int(v) for v in origin))
+        size = Size2D(*(int(v) for v in size))
+        mb, nb = parent.block_size
+        if origin.row % mb or origin.col % nb:
+            raise ValueError(f"MatrixRef origin {tuple(origin)} not tile-aligned ({mb}x{nb})")
+        if (
+            origin.row < 0
+            or origin.col < 0
+            or origin.row + size.rows > parent.size.rows
+            or origin.col + size.cols > parent.size.cols
+        ):
+            raise ValueError(
+                f"MatrixRef {tuple(origin)}+{tuple(size)} out of bounds {tuple(parent.size)}"
+            )
+        for ext, blk, off, tot in (
+            (size.rows, mb, origin.row, parent.size.rows),
+            (size.cols, nb, origin.col, parent.size.cols),
+        ):
+            if ext % blk and off + ext != tot:
+                raise ValueError(
+                    "MatrixRef extent must be a tile multiple or reach the parent edge"
+                )
+        object.__setattr__(self, "parent", parent)
+        object.__setattr__(self, "origin", origin)
+        object.__setattr__(self, "size", size)
+
+    # -- geometry ---------------------------------------------------------
+    @property
+    def block_size(self) -> Size2D:
+        return self.parent.block_size
+
+    @property
+    def grid(self):
+        return self.parent.grid
+
+    @property
+    def dtype(self):
+        return self.parent.dtype
+
+    @property
+    def tile_origin(self) -> Index2D:
+        return Index2D(
+            self.origin.row // self.parent.block_size.rows,
+            self.origin.col // self.parent.block_size.cols,
+        )
+
+    @property
+    def nr_tiles(self) -> Size2D:
+        mb, nb = self.parent.block_size
+        return Size2D(-(-self.size.rows // mb), -(-self.size.cols // nb))
+
+    @property
+    def dist(self) -> Distribution:
+        """Sub-distribution of the view: same grid, source rank = owner of
+        the view's first tile (reference: SubDistributionSpec,
+        distribution.h:64)."""
+        return self.parent.dist.sub_distribution(tuple(self.origin), tuple(self.size))
+
+    # -- materialization (the one place a copy happens) -------------------
+    def materialize(self) -> DistributedMatrix:
+        """Copy the window out into a standalone source-rank-(0,0)
+        DistributedMatrix (for consumers without sub-range support)."""
+        from dlaf_tpu.matrix import util as mutil
+
+        return mutil.sub_matrix(self.parent, tuple(self.origin), tuple(self.size))
+
+
+def as_ref(mat) -> MatrixRef:
+    """View covering the whole matrix (no-op window)."""
+    if isinstance(mat, MatrixRef):
+        return mat
+    return MatrixRef(mat, (0, 0), tuple(mat.size))

@@ -1,0 +1,144 @@
+"""MatrixRef views + sub-range GEMM (reference:
+test/unit/matrix/test_matrix_ref.cpp and
+test/unit/multiplication/test_multiplication_general.cpp — the sub-range
+cases of GeneralSub::callNN)."""
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.matrix.ref import MatrixRef, as_ref
+from dlaf_tpu.algorithms.multiplication import general_sub_multiplication
+
+
+def _mk(grid, m, n, nb, seed, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    if np.dtype(dtype).kind == "c":
+        g = (rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))).astype(dtype)
+    else:
+        g = rng.standard_normal((m, n)).astype(dtype)
+    return g, DistributedMatrix.from_global(grid, g, (nb, nb))
+
+
+def test_ref_geometry(grid_2x4):
+    _, mat = _mk(grid_2x4, 24, 24, 4, 0)
+    r = MatrixRef(mat, (8, 4), (12, 16))
+    assert tuple(r.size) == (12, 16)
+    assert tuple(r.tile_origin) == (2, 1)
+    assert tuple(r.nr_tiles) == (3, 4)
+    assert tuple(r.dist.size) == (12, 16)
+    # source rank of tile (2,1) on a 2x4 grid
+    assert tuple(r.dist.source_rank) == (2 % 2, 1 % 4)
+    with pytest.raises(ValueError):
+        MatrixRef(mat, (3, 0), (8, 8))  # unaligned origin
+    with pytest.raises(ValueError):
+        MatrixRef(mat, (0, 0), (6, 8))  # interior partial tile
+    with pytest.raises(ValueError):
+        MatrixRef(mat, (16, 16), (12, 8))  # out of bounds
+
+
+def test_ref_materialize(grid_2x4):
+    g, mat = _mk(grid_2x4, 24, 20, 4, 1)
+    r = MatrixRef(mat, (8, 4), (16, 12))
+    np.testing.assert_array_equal(r.materialize().to_global(), g[8:24, 4:16])
+    # edge-clipped extent (partial tile at the parent edge is allowed)
+    r2 = MatrixRef(mat, (12, 16), (12, 4))
+    np.testing.assert_array_equal(r2.materialize().to_global(), g[12:24, 16:20])
+
+
+@pytest.mark.parametrize("alpha,beta", [(1.0, 1.0), (2.0, 0.0), (-1.0, 0.5)])
+def test_sub_gemm_aligned(grid_2x4, alpha, beta):
+    """Equal origins (the reference callNN case): diagonal tile sub-range."""
+    n, nb = 32, 4
+    ga, a = _mk(grid_2x4, n, n, nb, 2)
+    gb, b = _mk(grid_2x4, n, n, nb, 3)
+    gc, c = _mk(grid_2x4, n, n, nb, 4)
+    o, s = (8, 8), (16, 16)
+    general_sub_multiplication(
+        alpha, MatrixRef(a, o, s), MatrixRef(b, o, s), beta, MatrixRef(c, o, s)
+    )
+    ref = gc.copy()
+    ref[8:24, 8:24] = alpha * ga[8:24, 8:24] @ gb[8:24, 8:24] + beta * gc[8:24, 8:24]
+    np.testing.assert_allclose(c.to_global(), ref, atol=1e-12)
+
+
+def test_sub_gemm_misaligned_origins(grid_2x4):
+    """Different per-operand origins exercise the gathered-panel paths."""
+    n, nb = 40, 4
+    ga, a = _mk(grid_2x4, n, n, nb, 5)
+    gb, b = _mk(grid_2x4, n, n, nb, 6)
+    gc, c = _mk(grid_2x4, n, n, nb, 7)
+    # C[4:20, 8:24] += A[12:28, 0:12] @ B[20:32, 16:32]
+    general_sub_multiplication(
+        1.0,
+        MatrixRef(a, (12, 0), (16, 12)),
+        MatrixRef(b, (20, 16), (12, 16)),
+        1.0,
+        MatrixRef(c, (4, 8), (16, 16)),
+    )
+    ref = gc.copy()
+    ref[4:20, 8:24] += ga[12:28, 0:12] @ gb[20:32, 16:32]
+    np.testing.assert_allclose(c.to_global(), ref, atol=1e-12)
+
+
+def test_sub_gemm_rect_and_edge(grid_2x4):
+    """Rectangular views, edge-clipped extents, complex dtype."""
+    m, n, nb = 28, 36, 4
+    ga, a = _mk(grid_2x4, m, n, nb, 8, np.complex128)
+    gb, b = _mk(grid_2x4, n, m, nb, 9, np.complex128)
+    gc, c = _mk(grid_2x4, m, m, nb, 10, np.complex128)
+    # full matrices through as_ref (whole-matrix views)
+    general_sub_multiplication(1.0 + 0.5j, as_ref(a), as_ref(b), 1.0, as_ref(c))
+    ref = gc + (1.0 + 0.5j) * ga @ gb
+    np.testing.assert_allclose(c.to_global(), ref, atol=1e-11)
+
+
+def test_sub_gemm_grids(comm_grids):
+    n, nb = 24, 4
+    for grid in comm_grids[:4]:
+        ga, a = _mk(grid, n, n, nb, 11)
+        gb, b = _mk(grid, n, n, nb, 12)
+        gc, c = _mk(grid, n, n, nb, 13)
+        general_sub_multiplication(
+            1.0,
+            MatrixRef(a, (4, 8), (12, 8)),
+            MatrixRef(b, (8, 12), (8, 12)),
+            2.0,
+            MatrixRef(c, (12, 4), (12, 12)),
+        )
+        ref = gc.copy()
+        ref[12:24, 4:16] = ga[4:16, 8:16] @ gb[8:16, 12:24] + 2.0 * gc[12:24, 4:16]
+        np.testing.assert_allclose(c.to_global(), ref, atol=1e-12)
+
+
+def test_sub_gemm_same_parent(grid_2x4):
+    """A and C windows in the SAME matrix (the canonical MatrixRef use —
+    e.g. D&C eigenvector updates): must not donate the shared buffer."""
+    n, nb = 32, 4
+    gm, m = _mk(grid_2x4, n, n, nb, 20)
+    gb, b = _mk(grid_2x4, n, n, nb, 21)
+    # M[16:32, 0:16] += M[0:16, 0:16] @ B[0:16, 0:16]
+    general_sub_multiplication(
+        1.0,
+        MatrixRef(m, (0, 0), (16, 16)),
+        MatrixRef(b, (0, 0), (16, 16)),
+        1.0,
+        MatrixRef(m, (16, 0), (16, 16)),
+    )
+    ref = gm.copy()
+    ref[16:32, 0:16] += gm[0:16, 0:16] @ gb[0:16, 0:16]
+    np.testing.assert_allclose(m.to_global(), ref, atol=1e-12)
+
+
+def test_sub_gemm_local_grid(grid_1x1):
+    n, nb = 16, 4
+    ga, a = _mk(grid_1x1, n, n, nb, 14)
+    gb, b = _mk(grid_1x1, n, n, nb, 15)
+    gc, c = _mk(grid_1x1, n, n, nb, 16)
+    general_sub_multiplication(
+        1.0, MatrixRef(a, (4, 4), (8, 8)), MatrixRef(b, (0, 8), (8, 8)),
+        1.0, MatrixRef(c, (8, 0), (8, 8)),
+    )
+    ref = gc.copy()
+    ref[8:16, 0:8] += ga[4:12, 4:12] @ gb[0:8, 8:16]
+    np.testing.assert_allclose(c.to_global(), ref, atol=1e-12)
