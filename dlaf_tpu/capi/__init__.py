@@ -1,0 +1,48 @@
+"""C-ABI surface: a C-callable shared library over the scalapack layer.
+
+Analogue of the reference's ``dlaf_c`` C API / ``src/c_api``
+(reference: include/dlaf_c/grid.h:31-77, include/dlaf_c/desc.h,
+include/dlaf_c/eigensolver/eigensolver.h:36-119).  ``build_shim()``
+compiles ``shim.cpp`` — which embeds CPython and forwards to
+``dlaf_tpu.capi.bridge`` — into ``libdlaf_tpu_c.so``; C/Fortran callers
+link it and include ``dlaf_c.h``.  See the header for the ABI contract
+(single-controller: global column-major buffers, no MPI).
+"""
+from __future__ import annotations
+
+import os
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libdlaf_tpu_c.so")
+_SRC = os.path.join(_HERE, "shim.cpp")
+_HDR = os.path.join(_HERE, "dlaf_c.h")
+
+_lock = threading.Lock()
+
+
+def header_path() -> str:
+    return _HDR
+
+
+def build_shim() -> str | None:
+    """Build (if stale — vs shim.cpp AND dlaf_c.h) and return the path of
+    the C-ABI shared library, or None when the toolchain/libpython is
+    unavailable."""
+    from dlaf_tpu.common.nativebuild import atomic_build
+
+    with _lock:
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        pylib = (sysconfig.get_config_var("LDLIBRARY") or "").replace(
+            ".so", ""
+        ).replace("lib", "", 1)
+        if not pylib:
+            return None
+        flags = [
+            "-O2", "-std=c++17", f"-I{inc}",
+            f"-L{libdir}", f"-l{pylib}", f"-Wl,-rpath,{libdir}",
+        ]
+        ok = atomic_build([_SRC], _SO, [flags], deps=[_HDR])
+        return _SO if ok else None

@@ -1,0 +1,110 @@
+"""Python side of the C-ABI shim (called from shim.cpp via the embedded
+interpreter).
+
+Wraps raw column-major buffer addresses into zero-copy numpy views, runs
+the scalapack layer, and writes results back through the caller's buffers
+(reference: src/c_api/ — there BLACS locals wrapped into dlaf::Matrix; here
+the full global buffer wrapped into DistributedMatrix.from_global).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import sys
+import traceback
+
+import numpy as np
+
+
+def _setup_jax(dtype: np.dtype) -> None:
+    import jax
+
+    from dlaf_tpu.common.nativebuild import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+    if np.dtype(dtype).itemsize >= 8:
+        jax.config.update("jax_enable_x64", True)
+
+
+def _view(addr: int, desc, dtype) -> np.ndarray:
+    """m x n writable view of the caller's column-major lld x n buffer."""
+    _, _, m, n, _, _, _, _, lld = desc
+    if lld < m:
+        raise ValueError(f"desc lld {lld} < m {m}")
+    nbytes = int(lld) * int(n) * np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * nbytes).from_address(addr)
+    full = np.frombuffer(buf, dtype=dtype).reshape((int(n), int(lld))).T
+    return full[: int(m), :]  # writable (frombuffer of a ctypes array)
+
+
+def _descriptor(desc):
+    from dlaf_tpu.scalapack.api import Descriptor
+
+    _, _, m, n, mb, nb, rsrc, csrc, _ = desc
+    return Descriptor(int(m), int(n), int(mb), int(nb), int(rsrc), int(csrc))
+
+
+def c_create_grid(nprow: int, npcol: int) -> int:
+    try:
+        _setup_jax(np.float32)
+        from dlaf_tpu.scalapack.api import create_grid
+
+        return int(create_grid(nprow, npcol))
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return -1
+
+
+def c_free_grid(ctx: int) -> int:
+    try:
+        from dlaf_tpu.scalapack.api import free_grid
+
+        free_grid(int(ctx))
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return -1
+
+
+def c_potrf(uplo: str, addr: int, desc, dtype_str: str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import ppotrf
+
+        a = _view(addr, desc, dtype)
+        ctx = int(desc[1])
+        out = ppotrf(ctx, str(uplo), np.ascontiguousarray(a), _descriptor(desc))
+        # ScaLAPACK p?potrf semantics: only the factored triangle is
+        # written; the caller's opposite triangle is left untouched
+        if str(uplo).upper() == "L":
+            a[:, :] = np.tril(out) + np.triu(a, 1)
+        else:
+            a[:, :] = np.triu(out) + np.tril(a, -1)
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_syevd(uplo: str, a_addr: int, desca, w_addr: int, z_addr: int,
+            descz, dtype_str: str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import pheevd
+
+        a = _view(a_addr, desca, dtype)
+        z = _view(z_addr, descz, dtype)
+        m = int(desca[2])
+        wbytes = m * np.dtype(dtype).itemsize
+        wbuf = (ctypes.c_char * wbytes).from_address(w_addr)
+        w = np.frombuffer(wbuf, dtype=dtype)
+        ctx = int(desca[1])
+        ev, evec = pheevd(ctx, str(uplo), np.ascontiguousarray(a), _descriptor(desca))
+        w[:] = ev.astype(dtype, copy=False)
+        z[:, :] = evec
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1

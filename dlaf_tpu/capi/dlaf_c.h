@@ -1,0 +1,61 @@
+/* C ABI for the dlaf_tpu framework.
+ *
+ * TPU-native analogue of the reference C API
+ * (reference: include/dlaf_c/grid.h:31-77, include/dlaf_c/desc.h,
+ * include/dlaf_c/factorization/cholesky.h, include/dlaf_c/eigensolver/
+ * eigensolver.h:36-119).  Differences, owed to the single-controller
+ * execution model (no MPI in the loop):
+ *
+ *  - matrices are passed as the FULL GLOBAL column-major buffer (in real
+ *    ScaLAPACK the per-rank local block-cyclic buffer); the block-cyclic
+ *    distribution over the TPU device mesh happens inside the library,
+ *  - dlaf_create_grid takes (nprow, npcol) directly instead of an MPI
+ *    communicator / BLACS context,
+ *  - routines RETURN the info code instead of writing through an out
+ *    pointer.
+ *
+ * desc9 follows the ScaLAPACK DESC_ layout:
+ *   [ dtype_, ctxt_, m_, n_, mb_, nb_, rsrc_, csrc_, lld_ ]
+ * where ctxt_ is the context returned by dlaf_create_grid and lld_ >= m_
+ * is the leading dimension of the column-major buffer.
+ *
+ * The implementing shared library embeds a CPython interpreter; the
+ * dlaf_tpu package must be importable (set PYTHONPATH accordingly).
+ */
+#ifndef DLAF_TPU_C_H
+#define DLAF_TPU_C_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the embedded interpreter + JAX runtime (idempotent; called
+ * implicitly by every routine).  Returns 0 on success. */
+int dlaf_tpu_init(void);
+
+/* Tear down the embedded interpreter IF this library created it. */
+void dlaf_tpu_finalize(void);
+
+/* Register a nprow x npcol device grid; returns a context for desc9[1]
+ * (negative on failure).  (reference: dlaf_create_grid, grid.h:31) */
+int dlaf_create_grid(int nprow, int npcol);
+void dlaf_free_grid(int ctx);
+
+/* Cholesky factorization, lower/upper per uplo ('L'/'U').
+ * (reference: dlaf_c/factorization/cholesky.h dlaf_p{s,d}potrf) */
+int dlaf_pspotrf(char uplo, float* a, const int desca[9]);
+int dlaf_pdpotrf(char uplo, double* a, const int desca[9]);
+
+/* Hermitian/symmetric eigensolver: eigenvalues into w[0..m), eigenvectors
+ * into z (column-major, descz).  (reference: dlaf_c/eigensolver/
+ * eigensolver.h dlaf_p{s,d}syevd) */
+int dlaf_pssyevd(char uplo, float* a, const int desca[9], float* w,
+                 float* z, const int descz[9]);
+int dlaf_pdsyevd(char uplo, double* a, const int desca[9], double* w,
+                 double* z, const int descz[9]);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* DLAF_TPU_C_H */

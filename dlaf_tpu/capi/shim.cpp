@@ -1,0 +1,126 @@
+/* C-ABI shim: embeds CPython and forwards to dlaf_tpu.capi.bridge.
+ *
+ * Analogue of the reference src/c_api/ translation units: where the
+ * reference wraps BLACS buffers into dlaf::Matrix and posts to the pika
+ * runtime, this shim wraps the caller's column-major buffer address into
+ * numpy (zero-copy) and calls the Python scalapack layer, which runs the
+ * JAX/XLA SPMD kernels.  See dlaf_c.h for the ABI contract.
+ */
+#include <Python.h>
+
+#include "dlaf_c.h"
+
+static PyThreadState* g_owned_tstate = NULL;
+static int g_we_initialized = 0;
+
+int dlaf_tpu_init(void) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = 1;
+    /* release the GIL so every entry point can use PyGILState_Ensure */
+    g_owned_tstate = PyEval_SaveThread();
+  }
+  return 0;
+}
+
+void dlaf_tpu_finalize(void) {
+  if (g_we_initialized && Py_IsInitialized()) {
+    if (g_owned_tstate) PyEval_RestoreThread(g_owned_tstate);
+    Py_Finalize();
+    g_owned_tstate = NULL;
+    g_we_initialized = 0;
+  }
+}
+
+/* Call dlaf_tpu.capi.bridge.<fn>(*args); returns a NEW reference or NULL
+ * (with the Python error printed to stderr). */
+static PyObject* call_bridge(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("dlaf_tpu.capi.bridge");
+  if (!mod) {
+    PyErr_Print();
+    Py_XDECREF(args);
+    return NULL;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (!f) {
+    PyErr_Print();
+    Py_XDECREF(args);
+    return NULL;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (!r) PyErr_Print();
+  return r;
+}
+
+static PyObject* desc_tuple(const int d[9]) {
+  PyObject* t = PyTuple_New(9);
+  for (int i = 0; i < 9; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(d[i]));
+  return t;
+}
+
+static int run_potrf(char uplo, void* a, const int desca[9], const char* dt) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), dt);
+  PyObject* r = call_bridge("c_potrf", args);
+  int info = r ? (int)PyLong_AsLong(r) : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return info;
+}
+
+static int run_syevd(char uplo, void* a, const int desca[9], void* w,
+                     void* z, const int descz[9], const char* dt) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKKNs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), (unsigned long long)(uintptr_t)w,
+      (unsigned long long)(uintptr_t)z, desc_tuple(descz), dt);
+  PyObject* r = call_bridge("c_syevd", args);
+  int info = r ? (int)PyLong_AsLong(r) : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return info;
+}
+
+int dlaf_create_grid(int nprow, int npcol) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(ii)", nprow, npcol);
+  PyObject* r = call_bridge("c_create_grid", args);
+  int ctx = r ? (int)PyLong_AsLong(r) : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+  return ctx;
+}
+
+void dlaf_free_grid(int ctx) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue("(i)", ctx);
+  PyObject* r = call_bridge("c_free_grid", args);
+  Py_XDECREF(r);
+  PyGILState_Release(st);
+}
+
+int dlaf_pspotrf(char uplo, float* a, const int desca[9]) {
+  return run_potrf(uplo, a, desca, "f4");
+}
+int dlaf_pdpotrf(char uplo, double* a, const int desca[9]) {
+  return run_potrf(uplo, a, desca, "f8");
+}
+int dlaf_pssyevd(char uplo, float* a, const int desca[9], float* w, float* z,
+                 const int descz[9]) {
+  return run_syevd(uplo, a, desca, w, z, descz, "f4");
+}
+int dlaf_pdsyevd(char uplo, double* a, const int desca[9], double* w,
+                 double* z, const int descz[9]) {
+  return run_syevd(uplo, a, desca, w, z, descz, "f8");
+}

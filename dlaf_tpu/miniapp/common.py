@@ -24,13 +24,9 @@ try:
 except Exception:
     pass
 
-# honor JAX_PLATFORMS even when a sitecustomize force-registered an
-# experimental backend plugin (the env var alone is not authoritative then)
-if os.environ.get("JAX_PLATFORMS"):
-    try:
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    except Exception:
-        pass
+from dlaf_tpu.common.nativebuild import honor_jax_platforms_env
+
+honor_jax_platforms_env()
 
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Size2D

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -26,62 +25,26 @@ _tried = False
 
 
 def _build() -> bool:
-    """Compile to a temp file and atomically rename: concurrent processes
-    (or a shared package dir across hosts) must never observe a half-written
-    .so.  Cross-process exclusion via an flock'd lockfile; -march=x86-64-v3
-    instead of native so a .so built on one host doesn't SIGILL on another
-    sharing the directory (falls back to -march=native if v3 unsupported)."""
-    import tempfile
+    """Compile via the shared atomic temp-file + flock discipline
+    (common/nativebuild.py).  -march=x86-64-v3 instead of native so a .so
+    built on one host doesn't SIGILL on another sharing the directory,
+    gated on actual AVX2 support (falls back to -march=native);
+    overridable via DLAF_TPU_NATIVE_MARCH for shared-package-dir
+    deployments."""
+    from dlaf_tpu.common.nativebuild import atomic_build
 
-    lock_path = _SO + ".lock"
-    try:
-        import fcntl
-
-        lock_f = open(lock_path, "w")
-        fcntl.flock(lock_f, fcntl.LOCK_EX)
-    except Exception:
-        lock_f = None
-    tmp = None
-    try:
-        # another process may have finished the build while we waited
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-            return True
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-        os.close(fd)
-        # x86-64-v3 compiles regardless of the build host's CPU, so gate it
-        # on actual AVX2 support; otherwise (pre-AVX2 x86, non-x86) use
-        # -march=native.  Overridable for shared-package-dir deployments.
-        march = os.environ.get("DLAF_TPU_NATIVE_MARCH")
-        if march is None:
-            try:
-                with open("/proc/cpuinfo") as f:
-                    march = "x86-64-v3" if "avx2" in f.read().split() else "native"
-            except OSError:
-                march = "native"
-        for m in dict.fromkeys([march, "native"]):
-            cmd = [
-                "g++", "-O3", f"-march={m}", "-shared", "-fPIC", "-std=c++17",
-                "-o", tmp, _SRC, "-lpthread",
-            ]
-            try:
-                r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
-            except Exception:
-                continue
-            if r.returncode == 0:
-                os.chmod(tmp, 0o755)
-                os.rename(tmp, _SO)
-                return True
-        return False
-    except Exception:
-        return False
-    finally:
-        if tmp is not None and os.path.exists(tmp):
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-        if lock_f is not None:
-            lock_f.close()
+    march = os.environ.get("DLAF_TPU_NATIVE_MARCH")
+    if march is None:
+        try:
+            with open("/proc/cpuinfo") as f:
+                march = "x86-64-v3" if "avx2" in f.read().split() else "native"
+        except OSError:
+            march = "native"
+    variants = [
+        ["-O3", f"-march={m}", "-std=c++17", "-lpthread"]
+        for m in dict.fromkeys([march, "native"])
+    ]
+    return atomic_build([_SRC], _SO, variants)
 
 
 def get_lib():

@@ -19,7 +19,9 @@ import jax  # noqa: E402
 # The axon sitecustomize force-registers the TPU tunnel platform; override it
 # after import but before backend initialization so tests run on the virtual
 # 8-device CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+from dlaf_tpu.common.nativebuild import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

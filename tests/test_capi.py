@@ -1,0 +1,155 @@
+"""C-ABI shim tests (reference: test/unit/c_api/ — grid + potrf + syevd
+round-trips through the C surface).
+
+Two tiers: ctypes calls into the shim from this process (the embedded-
+interpreter branch where CPython already runs), and a genuine C driver
+compiled with g++ and executed as a subprocess (the embedding branch)."""
+import ctypes
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.capi import build_shim, header_path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def shim():
+    so = build_shim()
+    if so is None:
+        pytest.skip("C-ABI shim unavailable (no g++/libpython)")
+    return so
+
+
+def _spd(n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+def _desc9(ctx, m, n, mb, nb, lld=None):
+    return (ctypes.c_int * 9)(1, ctx, m, n, mb, nb, 0, 0, lld or m)
+
+
+def test_capi_inprocess_potrf(shim):
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pdpotrf.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 4)
+    assert ctx > 0
+    n, nb = 16, 4
+    a = _spd(n, np.float64)
+    buf = np.asfortranarray(a)  # column-major, as the ABI specifies
+    buf[np.triu_indices(n, 1)] = 7.25  # sentinel: p?potrf must not touch it
+    rc = lib.dlaf_pdpotrf(
+        ctypes.c_char(b"L"),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _desc9(ctx, n, n, nb, nb),
+    )
+    assert rc == 0
+    l = np.tril(buf)
+    np.testing.assert_allclose(l @ l.T, a, atol=1e-10)
+    assert (buf[np.triu_indices(n, 1)] == 7.25).all()
+    lib.dlaf_free_grid(ctx)
+
+
+def test_capi_inprocess_syevd(shim):
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pdsyevd.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb = 16, 4
+    a = _spd(n, np.float64, seed=1)
+    abuf = np.asfortranarray(np.tril(a))
+    w = np.zeros(n, np.float64)
+    z = np.asfortranarray(np.zeros((n, n), np.float64))
+    rc = lib.dlaf_pdsyevd(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _desc9(ctx, n, n, nb, nb),
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        z.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _desc9(ctx, n, n, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), atol=1e-9)
+    resid = np.abs(a @ z - z * w[None, :]).max()
+    assert resid < 1e-9 * np.abs(a).max() * n
+    lib.dlaf_free_grid(ctx)
+
+
+C_DRIVER = r"""
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include "dlaf_c.h"
+
+int main(void) {
+  const int n = 12, nb = 4;
+  double *a = malloc(n * n * sizeof(double));
+  double *orig = malloc(n * n * sizeof(double));
+  /* SPD: B B^T + n I with a fixed pseudo-random B, column-major */
+  unsigned s = 1234567;
+  double b[144];
+  for (int i = 0; i < n * n; ++i) {
+    s = s * 1103515245u + 12345u;
+    b[i] = ((double)(s >> 16) / 32768.0) - 1.0;
+  }
+  for (int j = 0; j < n; ++j)
+    for (int i = 0; i < n; ++i) {
+      double acc = 0;
+      for (int k = 0; k < n; ++k) acc += b[i + k * n] * b[j + k * n];
+      a[i + j * n] = acc + (i == j ? n : 0);
+      orig[i + j * n] = a[i + j * n];
+    }
+  int ctx = dlaf_create_grid(2, 2);
+  if (ctx <= 0) { printf("GRID FAIL %d\n", ctx); return 1; }
+  int desc[9] = {1, ctx, n, n, nb, nb, 0, 0, n};
+  int rc = dlaf_pdpotrf('L', a, desc);
+  if (rc != 0) { printf("POTRF FAIL %d\n", rc); return 1; }
+  /* check L L^T == orig */
+  double maxerr = 0;
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j) {
+      double acc = 0;
+      for (int k = 0; k <= (i < j ? i : j); ++k)
+        acc += a[i + k * n] * a[j + k * n];
+      double e = fabs(acc - orig[i + j * n]);
+      if (e > maxerr) maxerr = e;
+    }
+  dlaf_free_grid(ctx);
+  dlaf_tpu_finalize();
+  if (maxerr < 1e-10) { printf("C CHECK PASSED (err=%g)\n", maxerr); return 0; }
+  printf("C CHECK FAILED (err=%g)\n", maxerr);
+  return 1;
+}
+"""
+
+
+def test_capi_from_c_program(shim):
+    """The embedding branch: a real C executable, no Python in the caller."""
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "driver.c")
+        exe = os.path.join(td, "driver")
+        with open(src, "w") as f:
+            f.write(C_DRIVER)
+        inc_dir = os.path.dirname(header_path())
+        r = subprocess.run(
+            ["gcc", "-O1", src, "-o", exe, f"-I{inc_dir}", shim,
+             f"-Wl,-rpath,{os.path.dirname(shim)}", "-lm"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        r = subprocess.run(
+            [exe], capture_output=True, text=True, timeout=420, env=env
+        )
+        assert "C CHECK PASSED" in r.stdout, (r.stdout, r.stderr[-2000:])
