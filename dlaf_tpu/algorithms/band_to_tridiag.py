@@ -149,8 +149,6 @@ def band_to_tridiagonal_hh(mat_band: DistributedMatrix, band: int | None = None)
 
     ``e`` is real; for complex dtypes any residual subdiagonal phase (only
     the final entry, which no sweep covers) is folded into ``phases``."""
-    from dlaf_tpu.native import band2trid_hh
-
     if band is None:
         band = getattr(mat_band, "band_size", mat_band.block_size.rows)
     dt = np.dtype(mat_band.dtype)
@@ -158,12 +156,34 @@ def band_to_tridiagonal_hh(mat_band: DistributedMatrix, band: int | None = None)
     if m == 0:
         return None
     ab = extract_band_storage(mat_band, band)
+    return band_to_tridiagonal_hh_storage(ab, band, dt)
+
+
+def band_to_tridiagonal_hh_storage(ab: np.ndarray, band: int, dt):
+    """``band_to_tridiagonal_hh`` on compact (>= band+2, n) lower-band
+    storage directly (the SBR second stage hands its reduced band here)."""
+    from dlaf_tpu.native import band2trid_hh
+
     out = band2trid_hh(ab, band)
     if out is None:
         return None
     d, e_raw, v_refl, taus = out
-    norm = _normalize_phases(d, e_raw, None, dt)
+    norm = _normalize_phases(d, e_raw, None, np.dtype(dt))
     return norm.d, norm.e, norm.phases, v_refl, taus, band
+
+
+def band_to_tridiagonal_storage(ab: np.ndarray, band: int, dt) -> "BandToTridiagResult | None":
+    """Eigenvalues-only native chase on compact lower-band storage: (d, e)
+    with phases normalized, q None — or None when the native kernel is
+    unavailable (shared by band_to_tridiagonal's native branch and the
+    eigenvalues-only SBR path)."""
+    from dlaf_tpu.native import band2trid_native
+
+    native = band2trid_native(ab, band, want_q=False)
+    if native is None:
+        return None
+    d_n, e_n, _ = native
+    return _normalize_phases(d_n, e_n, None, np.dtype(dt))
 
 
 def band_to_tridiagonal_stream(mat_band: DistributedMatrix, band: int | None = None):

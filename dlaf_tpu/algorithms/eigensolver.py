@@ -70,23 +70,27 @@ def hermitian_eigensolver(
     n = mat_a.size.rows
     band = get_band_size(nb)
     band_mat, taus = reduction_to_band(mat_a, band=band)
-    # default band stage: native Householder bulge chasing (O(N^2 b)
-    # reduction, compact reflector set, no N x N Q2 anywhere) with the
-    # blocked compact-WY back-transform running as GEMMs on device — the
-    # reference's strategy (band_to_tridiag/mc.h SweepWorker +
-    # bt_band_to_tridiag/impl.h grouped applies); full AND partial spectra.
+    # default band stage: (optional) on-device SBR band shrink, then native
+    # Householder bulge chasing (O(N^2 b_small) on host, compact reflector
+    # set, no N x N Q2 anywhere) with the blocked compact-WY back-transform
+    # running as GEMMs on device — the reference's strategy
+    # (band_to_tridiag/mc.h SweepWorker + bt_band_to_tridiag/impl.h grouped
+    # applies) plus the ELPA-style second stage; full AND partial spectra.
     # The tridiagonal stage defaults to the multi-level distributed D&C and
-    # its eigenvector matrix stays DISTRIBUTED through both back-transforms
+    # its eigenvector matrix stays DISTRIBUTED through all back-transforms
     # — no O(N^2) host object on this path.
-    from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal_hh
     from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh_dist
 
-    hh = band_to_tridiagonal_hh(band_mat, band=band)
+    hh, tr_sbr = _band_stage_hh(band_mat, band)
     if hh is not None:
         evals, v = tridiagonal_eigensolver(
             grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
         )
         e = bt_band_to_tridiagonal_hh_dist(hh, v)
+        if tr_sbr is not None:
+            from dlaf_tpu.algorithms.band_reduction import sbr_back_transform
+
+            e = sbr_back_transform(tr_sbr, e)
         e = bt_reduction_to_band(e, band_mat, taus)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage
@@ -97,6 +101,69 @@ def hermitian_eigensolver(
     e = bt_band_to_tridiagonal(b2t.q2, e_tri)
     e = bt_reduction_to_band(e, band_mat, taus)
     return EigResult(evals, e)
+
+
+def _sbr_target(band: int) -> int:
+    """SBR second-stage target band: largest divisor of ``band`` not above
+    ``eigensolver_sbr_band`` when that shrinks the band, else 0 (off).
+    -1 = auto: 32 on accelerator backends, off on CPU (there the "device"
+    SBR stage runs on the same CPU and costs more than it saves —
+    measured n=2048 A/B in docs/BENCHMARKS.md)."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    t_ = int(get_tune_parameters().eigensolver_sbr_band)
+    if t_ < 0:
+        import jax
+
+        t_ = 32 if jax.default_backend() != "cpu" else 0
+    if t_ <= 0 or band <= t_:
+        return 0
+    b2 = min(t_, band - 1)
+    while band % b2:
+        b2 -= 1
+    return b2 if b2 >= 2 else 0
+
+
+def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
+    """Band -> tridiagonal stage: optional on-device SBR shrink
+    (band -> b2, algorithms/band_reduction.py), then the native host bulge
+    chase at the small band.
+
+    ``want_q=True`` returns (hh tuple or None, SbrTransforms or None);
+    ``want_q=False`` returns (BandToTridiagResult or None, None) — the
+    eigenvalues-only variant with no transform storage.  A None first
+    element means the native kernel is unavailable; callers fall back to
+    the dense band stage on the ORIGINAL band matrix."""
+    from dlaf_tpu.algorithms.band_to_tridiag import (
+        band_to_tridiagonal_hh,
+        band_to_tridiagonal_hh_storage,
+        band_to_tridiagonal_storage,
+        extract_band_storage,
+    )
+    from dlaf_tpu.native import get_lib
+
+    dt = np.dtype(band_mat.dtype)
+    m = band_mat.size.rows
+    if m == 0:
+        return None, None
+    b2 = _sbr_target(band)
+    if b2 and get_lib() is not None:
+        from dlaf_tpu.algorithms.band_reduction import sbr_reduce
+
+        ab = extract_band_storage(band_mat, band)
+        ab2, tr = sbr_reduce(ab, band, b2, want_q=want_q)
+        if want_q:
+            hh = band_to_tridiagonal_hh_storage(ab2, b2, dt)
+            return hh, (tr if hh is not None and tr.n_sweeps else None)
+        return band_to_tridiagonal_storage(ab2, b2, dt), None
+    if want_q:
+        return band_to_tridiagonal_hh(band_mat, band=band), None
+    if get_lib() is not None:
+        return (
+            band_to_tridiagonal_storage(extract_band_storage(band_mat, band), band, dt),
+            None,
+        )
+    return None, None
 
 
 _eigh_cache = {}
@@ -151,7 +218,9 @@ def hermitian_eigenvalues(
         return res.eigenvalues
     band = get_band_size(mat_a.block_size.rows)
     band_mat, _ = reduction_to_band(mat_a, band=band)
-    b2t = band_to_tridiagonal(band_mat, band=band, want_q=False)
+    b2t, _ = _band_stage_hh(band_mat, band, want_q=False)
+    if b2t is None:
+        b2t = band_to_tridiagonal(band_mat, band=band, want_q=False)
     if b2t.d.shape[0] == 0:
         return b2t.d
     if spectrum is None:

@@ -8,12 +8,18 @@
  */
 #include <Python.h>
 
+#include <mutex>
+
 #include "dlaf_c.h"
 
 static PyThreadState* g_owned_tstate = NULL;
 static int g_we_initialized = 0;
+static std::mutex g_init_mutex;
 
 int dlaf_tpu_init(void) {
+  /* serialize: concurrent first calls from two C threads must not both
+   * run Py_InitializeEx */
+  std::lock_guard<std::mutex> lock(g_init_mutex);
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     g_we_initialized = 1;
@@ -24,6 +30,7 @@ int dlaf_tpu_init(void) {
 }
 
 void dlaf_tpu_finalize(void) {
+  std::lock_guard<std::mutex> lock(g_init_mutex);
   if (g_we_initialized && Py_IsInitialized()) {
     if (g_owned_tstate) PyEval_RestoreThread(g_owned_tstate);
     Py_Finalize();

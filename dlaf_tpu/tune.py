@@ -53,12 +53,19 @@ class TuneParameters:
     - ``cholesky_lookahead``: use the lookahead SPMD kernel (panel k+1
       overlapped with the bulk trailing update — benefits multi-chip
       meshes; the bucketed kernel is the single-chip default).
+    - ``eigensolver_sbr_band``: target band of the on-device SBR second
+      stage (algorithms/band_reduction.py); engages when the reduction
+      band exceeds it, shrinking the host bulge-chase cost by
+      band/sbr_band.  0 disables; -1 (default) = auto: 32 when the default
+      JAX backend is an accelerator, off on CPU (measured: the CPU-mesh
+      "device" stage costs more than the host chase it saves).
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
 
     default_block_size: int = field(default_factory=lambda: _env("default_block_size", 256, int))
     eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
+    eigensolver_sbr_band: int = field(default_factory=lambda: _env("eigensolver_sbr_band", -1, int))
     bt_apply_group_size: int = field(default_factory=lambda: _env("bt_apply_group_size", 1, int))
     bt_band_hh_group_size: int = field(
         default_factory=lambda: _env("bt_band_hh_group_size", 128, int)
