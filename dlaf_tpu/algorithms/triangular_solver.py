@@ -195,6 +195,99 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
     return coll.relocal(b)
 
 
+def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
+    """Lookahead variant of _trsm_left_kernel (reference: the next-panel
+    high-priority tasks of solver/triangular/impl.h): each iteration writes
+    back row k, applies the NARROW update to row k+1 only, immediately
+    solves row k+1 (its psum rides alongside the bulk einsum — XLA can
+    overlap the independent collective with the trailing update), then
+    runs the bulk update excluding row k+1.  The solved row flows through
+    the loop carry, exactly like cholesky's lookahead panel."""
+    a = coll.local(a)
+    b = coll.local(b)
+    myr, myc = coll.my_rank()
+    a = _spmd.pad_diag_identity(a, g_a, myr, myc)
+    lower = uplo == t.LOWER
+    forward = lower == (op == t.NO_TRANS)
+    mt = g_a.mt
+    b = (jnp.asarray(alpha, b.dtype) * b).astype(b.dtype)
+    gi = _spmd.local_row_tiles(g_b, myr)
+
+    def a_tile(k, i):
+        """op(A)[i, k] broadcast to every rank (one tile)."""
+        if op == t.NO_TRANS:
+            src_r, src_c = i, k
+        else:
+            src_r, src_c = k, i
+        rr, cc = src_r % g_a.pr, src_c % g_a.pc
+        tile = _spmd.take_tile(_spmd.take_col(a, src_c // g_a.pc, g_a), src_r // g_a.pr)
+        tile = coll.bcast2d(
+            jnp.where((myr == rr) & (myc == cc), tile, jnp.zeros_like(tile)), rr, cc
+        )
+        return t.op_tile(tile, op)
+
+    def solve_row(b, k):
+        kr = k % g_a.pr
+        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+        brow = _spmd.take_row(b, k // g_a.pr, g_b)
+        solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
+        xr = coll.psum_axis(
+            jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
+        )
+        return xr
+
+    def write_row(b, k, xr):
+        lkr = k // g_a.pr
+        brow = _spmd.take_row(b, lkr, g_b)
+        return _spmd.put_row(b, jnp.where(myr == k % g_a.pr, xr, brow), lkr)
+
+    def panel(k):
+        """cp[i] = op(A)[i, k] for local rows i beyond k (bulk update)."""
+        remaining = (gi > k) if forward else (gi < k)
+        if op == t.NO_TRANS:
+            kc = k % g_a.pc
+            ac = _spmd.take_col(a, k // g_a.pc, g_a)
+            return coll.psum_axis(
+                jnp.where((myc == kc) & remaining[:, None, None], ac, jnp.zeros_like(ac)),
+                COL_AXIS,
+            )
+        kr = k % g_a.pr
+        ar = _spmd.take_row(a, k // g_a.pr, g_a)
+        gj = _spmd.local_col_tiles(g_a, myc)
+        rem_j = (gj > k) if forward else (gj < k)
+        rp = coll.psum_axis(
+            jnp.where((myr == kr) & rem_j[:, None, None], ar, jnp.zeros_like(ar)),
+            ROW_AXIS,
+        )
+        cp = t.op_tile(coll.transpose_panel_rows(rp, g_a.mt, g_b.ltr), op)
+        return jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
+
+    def body(s, carry):
+        b, xr = carry
+        k = s if forward else mt - 1 - s
+        k1 = k + 1 if forward else k - 1
+        b = write_row(b, k, xr)
+        # narrow update: row k1 only, so its solve can start immediately
+        a1 = a_tile(k, k1)
+        lk1 = k1 // g_a.pr
+        brow1 = _spmd.take_row(b, lk1, g_b)
+        upd1 = jnp.einsum("ab,jbc->jac", a1, xr)
+        brow1 = jnp.where(myr == k1 % g_a.pr, brow1 - upd1, brow1)
+        b = _spmd.put_row(b, brow1, lk1)
+        xr1 = solve_row(b, k1)  # lookahead: overlaps with the bulk below
+        # bulk update, row k1 excluded (already updated)
+        cp = panel(k)
+        cp = jnp.where((gi == k1)[:, None, None], jnp.zeros_like(cp), cp)
+        b = b - jnp.einsum("iab,jbc->ijac", cp, xr)
+        return b, xr1
+
+    k0 = 0 if forward else mt - 1
+    xr0 = solve_row(b, k0)
+    b, xr = lax.fori_loop(0, mt - 1, body, (b, xr0))
+    b = write_row(b, mt - 1 if forward else 0, xr)
+    return coll.relocal(b)
+
+
 _cache = {}
 
 
@@ -257,8 +350,14 @@ def triangular_solver(
                 # e.g. backend compiler limits on very large dense solves —
                 # remember and use the tiled SPMD kernel instead
                 _local_cache[fail_key] = True
-    kern_fn = _trsm_left_bucketed_kernel if side == t.LEFT else _trsm_right_kernel
-    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b)
+    from dlaf_tpu.tune import get_tune_parameters
+
+    lookahead = side == t.LEFT and get_tune_parameters().trsm_lookahead and g_a.mt > 1
+    if side == t.LEFT:
+        kern_fn = _trsm_left_lookahead_kernel if lookahead else _trsm_left_bucketed_kernel
+    else:
+        kern_fn = _trsm_right_kernel
+    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b, lookahead)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

@@ -76,6 +76,7 @@ class TuneParameters:
         default_factory=lambda: _env("eigensolver_matmul_precision", "float32", str)
     )
     cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
+    trsm_lookahead: bool = field(default_factory=lambda: _env("trsm_lookahead", False, bool))
     debug_dump_eigensolver_data: bool = field(
         default_factory=lambda: _env("debug_dump_eigensolver_data", False, bool)
     )
