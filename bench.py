@@ -1,30 +1,39 @@
 #!/usr/bin/env python
-"""Headline benchmark: distributed Cholesky (POTRF) GFlop/s on the local chip.
+"""Headline benchmark: distributed Cholesky (POTRF) + HEEV on the local chip.
 
-Resilient staged protocol (a hung tunnel or cold compile cache must still
-produce a usable artifact):
+Resilient staged protocol — a hung tunnel, a cold compile cache, or a crash
+(even a segfault inside XLA) must still produce a usable artifact:
 
-1. device liveness probe — a tiny matmul with its own short deadline; if the
-   device is unresponsive we emit value=0 with a note and exit 124 instead of
-   hanging until the global watchdog.
-2. staged sizes N=4096 -> 8192 -> 16384 (nb=512, f32).  After EVERY completed
-   stage the best-so-far record is updated, so a timeout mid-way still reports
-   the largest completed config rather than 0.0.
+1. PARENT process: liveness probe in a RETRY LOOP of fresh subprocesses (a
+   fresh PJRT client per attempt: a wedged-then-recovering tunnel is retried
+   instead of giving up after one attempt, which produced two rounds of 0.0
+   artifacts).  Attempts are spaced ~55 s apart and continue until the device
+   answers or only enough budget is left to emit the artifact; every attempt
+   is logged into the emitted JSON (``probe_attempts``) so a dead-for-the-
+   whole-window device is *provably* dead, not just unprobed.
+2. CHILD process runs the stages and checkpoints the best-so-far record to a
+   state file after EVERY completed stage; the parent emits that record even
+   if the child hangs (killed at the deadline) or dies on a signal.  Staged
+   sizes N=2048 -> 4096 -> 8192 -> 16384 (nb=512, f32), smallest first so any
+   brief window of device liveness produces a nonzero record.  HEEV stages
+   (N=2048 -> 4096 -> 8192, full pipeline backend) are interleaved under a
+   time-budget check and reported in the ``heev`` sub-record.
 3. the headline value is the framework's distributed SPMD kernel
    (``backend='distributed'``), not XLA's dense single-device path; the dense
    ("auto"-on-1x1) number is reported alongside in ``auto_gflops``.
 
 ``vs_baseline`` compares f32 TPU GFlop/s against 10 TFlop/s — an A100-class
 per-device **f64** POTRF figure for the reference's GPU backend (the reference
-publishes no in-repo numbers; see BASELINE.md).  The dtype mismatch is noted in
-the emitted record itself.
+publishes no in-repo numbers; see BASELINE.md).  The dtype mismatch is noted
+in the emitted record itself.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 import json
 import os
+import subprocess
 import sys
-import threading
+import tempfile
 import time
 
 import numpy as np
@@ -38,140 +47,270 @@ def _env_int(name, default):
 
 NB = _env_int("DLAF_BENCH_NB", 512)
 STAGES = tuple(
-    int(s) for s in os.environ.get("DLAF_BENCH_STAGES", "4096,8192,16384").split(",") if s.strip().isdigit()
-) or (4096, 8192, 16384)
+    int(s) for s in os.environ.get("DLAF_BENCH_STAGES", "2048,4096,8192,16384").split(",") if s.strip().isdigit()
+) or (2048, 4096, 8192, 16384)
+HEEV_STAGES = tuple(
+    int(s) for s in os.environ.get("DLAF_BENCH_HEEV_STAGES", "2048,4096,8192").split(",") if s.strip().isdigit()
+)
 NRUNS = 2
 BASELINE_GFLOPS = 10000.0
 DTYPE_NOTE = "f32 TPU vs 10 TFlop/s f64 A100-class baseline (dtype mismatch, see BASELINE.md)"
 
-TIMEOUT_S = 470
-PROBE_TIMEOUT_S = 120
+TIMEOUT_S = _env_int("DLAF_BENCH_TIMEOUT", 470)
+PROBE_ATTEMPT_TIMEOUT_S = 55
+PROBE_FLOOR_S = 60  # stop probing when less than this budget remains
 
-_lock = threading.Lock()
-_emitted = False
-_best = {
-    "metric": f"potrf_gflops_nb{NB}_f32_1chip_distributed",
-    "value": 0.0,
-    "unit": "GFlop/s",
-    "vs_baseline": 0.0,
-    "note": "no stage completed",
-}
-
-
-def _emit_once():
-    global _emitted
-    with _lock:
-        if _emitted:
-            return
-        _emitted = True
-        print(json.dumps(_best))
-        sys.stdout.flush()
+# Fresh-process probe: its own PJRT client, its own deadline.  A tiny matmul
+# with a true execution barrier (float() forces a device_get) through
+# whatever platform the driver environment provides.
+_PROBE_SRC = """
+import os
+import numpy as np
+import jax
+if os.environ.get("DLAF_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["DLAF_BENCH_PLATFORM"])
+import jax.numpy as jnp
+x = jnp.ones((256, 256), np.float32)
+print("PROBE_OK", float(jnp.sum(x @ x)), jax.devices()[0].platform)
+"""
 
 
-def _record_stage(n, gflops, auto_gflops=None):
-    with _lock:
-        _best.update(
-            {
-                "metric": f"potrf_gflops_n{n}_nb{NB}_f32_1chip_distributed",
-                "value": round(gflops, 3),
-                "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
-                "note": DTYPE_NOTE,
-            }
-        )
-        if auto_gflops is not None:
-            _best["auto_gflops"] = round(auto_gflops, 3)
-        else:
-            # a stale dense-path number from an earlier (smaller-N) stage
-            # must not be attributed to this stage's record
-            _best.pop("auto_gflops", None)
+def _empty_record(note):
+    return {
+        "metric": f"potrf_gflops_nb{NB}_f32_1chip_distributed",
+        "value": 0.0,
+        "unit": "GFlop/s",
+        "vs_baseline": 0.0,
+        "note": note,
+        "probe_attempts": [],
+    }
 
 
-def _die(note, rc):
-    with _lock:
-        if _best["value"] == 0.0:
-            _best["note"] = note
-        else:
-            _best["note"] = f"{_best['note']}; {note}"
-    _emit_once()
-    os._exit(rc)
+# --------------------------- child ---------------------------------------
+
+class _Child:
+    """Runs the stages; checkpoints the record to ``state_path`` after every
+    completed stage (atomic rename) so the parent can emit the best-so-far
+    even if this process is killed mid-stage or crashes in native code."""
+
+    def __init__(self, state_path, deadline_s):
+        self.state_path = state_path
+        self.t0 = time.perf_counter()
+        self.deadline_s = deadline_s
+        self.rec = _empty_record("no stage completed")
+        del self.rec["probe_attempts"]  # the parent owns the probe log
+        self._flush()
+
+    def t_left(self):
+        return self.deadline_s - (time.perf_counter() - self.t0)
+
+    def _flush(self):
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self.state_path) or ".")
+        with os.fdopen(fd, "w") as f:
+            json.dump(self.rec, f)
+        os.replace(tmp, self.state_path)
+
+    def _note(self, msg):
+        self.rec.setdefault("stage_log", []).append(msg)
+        self._flush()
+
+    def _time_potrf(self, a_host, n, backend):
+        """Best wall time over NRUNS (first run = warmup/compile, untimed)."""
+        from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.common.index import Size2D
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+        from dlaf_tpu.miniapp.common import sync
+
+        grid = Grid.create(Size2D(1, 1))
+        best = None
+        for i in range(NRUNS + 1):
+            mat = DistributedMatrix.from_global(grid, a_host, (NB, NB))
+            sync(mat.data)
+            t0 = time.perf_counter()
+            out = cholesky_factorization("L", mat, backend=backend, _dump=False)
+            sync(out.data)
+            dt = time.perf_counter() - t0
+            if i == 0:
+                continue
+            best = dt if best is None else min(best, dt)
+        return best
+
+    def _time_heev(self, n):
+        """HEEV (full pipeline backend): warmup/compile run, then one timed
+        run if the budget allows; else the warmup time stands."""
+        import dlaf_tpu.testing as tu
+        from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.common.index import Size2D
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+        from dlaf_tpu.miniapp.common import sync
+
+        grid = Grid.create(Size2D(1, 1))
+        a = tu.random_hermitian_pd(n, np.float32, seed=2)
+        best = None
+        for i in range(2):
+            mat = DistributedMatrix.from_global(grid, np.tril(a), (NB, NB))
+            sync(mat.data)
+            t0 = time.perf_counter()
+            res = hermitian_eigensolver("L", mat, backend="pipeline")
+            sync(res.eigenvectors.data)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+            if i == 0 and self.t_left() < dt + 20:
+                break
+        return best
+
+    def run(self):
+        from dlaf_tpu.miniapp import common as _c  # noqa: F401  persistent compile cache
+        import jax
+
+        # Local-dev escape hatch: the axon sitecustomize force-registers the
+        # TPU tunnel platform and only a config update overrides it.
+        if os.environ.get("DLAF_BENCH_PLATFORM"):
+            jax.config.update("jax_platforms", os.environ["DLAF_BENCH_PLATFORM"])
+        import jax.numpy as jnp
+
+        x = jnp.ones((256, 256), np.float32)
+        float(jnp.sum(x @ x))  # warm this process's client through the tunnel
+
+        import dlaf_tpu.testing as tu
+
+        potrf_flops = lambda n: 2 * n**3 / 6  # n^3/6 adds + n^3/6 muls (reference types.h:160)
+        heev_flops = lambda n: 4 * n**3 / 3
+        heev_iter = iter(HEEV_STAGES)
+        next_heev = next(heev_iter, None)
+        for n in STAGES:
+            try:
+                a = tu.random_hermitian_pd(n, np.float32, seed=1)
+                dt = self._time_potrf(a, n, "distributed")
+                gf = potrf_flops(n) / dt / 1e9
+                self.rec.update(
+                    metric=f"potrf_gflops_n{n}_nb{NB}_f32_1chip_distributed",
+                    value=round(gf, 3),
+                    vs_baseline=round(gf / BASELINE_GFLOPS, 4),
+                    note=DTYPE_NOTE,
+                )
+                self.rec.pop("auto_gflops", None)  # stale smaller-N number
+                self._flush()
+                if self.t_left() > 60:
+                    dt_auto = self._time_potrf(a, n, "auto")
+                    self.rec["auto_gflops"] = round(potrf_flops(n) / dt_auto / 1e9, 3)
+                    self._flush()
+            except BaseException as e:  # noqa: BLE001 - keep earlier stages' record
+                self._note(f"potrf n={n} failed: {type(e).__name__}: {e}")
+            # interleave HEEV stages once the matching POTRF size is done
+            # (smallest-first again: a late kill still leaves a heev record)
+            while next_heev is not None and next_heev <= n:
+                if self.t_left() < 90:
+                    self._note(f"heev n={next_heev} skipped: {self.t_left():.0f}s left")
+                else:
+                    try:
+                        dt = self._time_heev(next_heev)
+                        self.rec["heev"] = {
+                            "metric": f"heev_n{next_heev}_nb{NB}_f32_1chip_pipeline",
+                            "seconds": round(dt, 3),
+                            "gflops": round(heev_flops(next_heev) / dt / 1e9, 3),
+                            "flops_model": "4/3 N^3 (tridiagonal-reduction count)",
+                        }
+                        self._flush()
+                    except BaseException as e:  # noqa: BLE001
+                        self._note(f"heev n={next_heev} failed: {type(e).__name__}: {e}")
+                next_heev = next(heev_iter, None)
+            if self.t_left() < 30:
+                self._note(f"stopping before n>{n}: {self.t_left():.0f}s left")
+                break
+        return 0
 
 
-def _time_potrf(a_host, n, backend):
-    """Best wall time over NRUNS (first run = warmup/compile, not timed)."""
-    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
-    from dlaf_tpu.comm.grid import Grid
-    from dlaf_tpu.common.index import Size2D
-    from dlaf_tpu.matrix.matrix import DistributedMatrix
-    from dlaf_tpu.miniapp.common import sync
+# --------------------------- parent --------------------------------------
 
-    grid = Grid.create(Size2D(1, 1))
-    best = None
-    for i in range(NRUNS + 1):
-        mat = DistributedMatrix.from_global(grid, a_host, (NB, NB))
-        sync(mat.data)
-        t0 = time.perf_counter()
-        out = cholesky_factorization("L", mat, backend=backend, _dump=False)
-        sync(out.data)
-        dt = time.perf_counter() - t0
-        if i == 0:
-            continue
-        best = dt if best is None else min(best, dt)
-    return best
+def _probe_until_alive(t_start, attempts):
+    """Retry the liveness probe in fresh subprocesses until the device
+    answers or the window closes.  Returns True when alive, False when the
+    window closed with the device still dead."""
+    while True:
+        elapsed = time.perf_counter() - t_start
+        if elapsed > TIMEOUT_S - PROBE_FLOOR_S:
+            return False
+        att = {"t": round(elapsed, 1)}
+        t_att = time.perf_counter()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_ATTEMPT_TIMEOUT_S,
+            )
+            if r.returncode == 0 and "PROBE_OK" in r.stdout:
+                att["outcome"] = "ok"
+                att["dt"] = round(time.perf_counter() - t_att, 1)
+                attempts.append(att)
+                return True
+            att["outcome"] = f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-200:]}"
+        except subprocess.TimeoutExpired:
+            att["outcome"] = f"timeout at {PROBE_ATTEMPT_TIMEOUT_S}s"
+        except Exception as e:  # noqa: BLE001
+            att["outcome"] = f"{type(e).__name__}: {e}"
+        att["dt"] = round(time.perf_counter() - t_att, 1)
+        attempts.append(att)
+        # space attempts out: a fast failure must not burn the window in a
+        # hot spin; the artifact should prove >=5 *spaced* attempts
+        wait = PROBE_ATTEMPT_TIMEOUT_S - (time.perf_counter() - t_att)
+        if wait > 0:
+            time.sleep(wait)
 
 
 def main():
     t_start = time.perf_counter()
-    # watchdog THREAD: a hung device/tunnel blocks the main thread inside
-    # C++ (block_until_ready/device_get), where SIGALRM handlers never run —
-    # a separate thread emits the best-so-far JSON artifact and exits 124
-    watchdog = threading.Timer(
-        TIMEOUT_S, lambda: _die(f"watchdog timeout at {TIMEOUT_S}s", 124)
+    attempts = []
+    if not _probe_until_alive(t_start, attempts):
+        rec = _empty_record(
+            f"device unresponsive for the whole window: {len(attempts)} probe "
+            f"attempts over {time.perf_counter() - t_start:.0f}s, each a fresh "
+            f"process/PJRT client with a {PROBE_ATTEMPT_TIMEOUT_S}s deadline"
+        )
+        rec["probe_attempts"] = attempts
+        print(json.dumps(rec))
+        return 124
+
+    budget = TIMEOUT_S - (time.perf_counter() - t_start) - 10
+    state = tempfile.NamedTemporaryFile(
+        prefix="dlaf_bench_state_", suffix=".json", delete=False
     )
-    watchdog.daemon = True
-    watchdog.start()
-
-    # ---- stage 0: device liveness probe (its own, shorter deadline) ----
-    probe = threading.Timer(
-        PROBE_TIMEOUT_S, lambda: _die(f"device unresponsive within {PROBE_TIMEOUT_S}s probe", 124)
-    )
-    probe.daemon = True
-    probe.start()
-    from dlaf_tpu.miniapp import common as _c  # enables the persistent compile cache
-    import jax
-
-    # Local-dev escape hatch: the axon sitecustomize force-registers the TPU
-    # tunnel platform and only a config update (not JAX_PLATFORMS) overrides it.
-    if os.environ.get("DLAF_BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["DLAF_BENCH_PLATFORM"])
-    import jax.numpy as jnp
-
-    x = jnp.ones((256, 256), np.float32)
-    float(jnp.sum(x @ x))  # true execution barrier through the tunnel
-    probe.cancel()
-
-    import dlaf_tpu.testing as tu
-
-    # ---- staged sizes; each completed stage updates the artifact ----
-    # any crash mid-stage must still emit the best-so-far record (same
-    # contract as the hang path), hence the try/except around the loop
-    flops = lambda n: 2 * n**3 / 6  # potrf: n^3/6 adds + n^3/6 muls (reference types.h:160)
+    state.close()
+    child_note = None
     try:
-        for n in STAGES:
-            a = tu.random_hermitian_pd(n, np.float32, seed=1)
-            dt_dist = _time_potrf(a, n, "distributed")
-            gf_dist = flops(n) / dt_dist / 1e9
-            _record_stage(n, gf_dist)
-            # dense/XLA single-device path alongside (cheap: kernel already warm)
-            if time.perf_counter() - t_start < TIMEOUT_S - 60:
-                dt_auto = _time_potrf(a, n, "auto")
-                _record_stage(n, gf_dist, auto_gflops=flops(n) / dt_auto / 1e9)
-    except BaseException as e:  # noqa: BLE001 - emit artifact, then report
-        _die(f"crash mid-stage: {type(e).__name__}: {e}", 1)
-
-    watchdog.cancel()
-    _emit_once()
-    return 0
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", state.name, f"{budget:.0f}"],
+            timeout=budget + 15,
+        )
+        if r.returncode != 0:
+            child_note = f"child exited rc={r.returncode}"
+            if r.returncode < 0:
+                child_note += " (killed by signal — crash in native code?)"
+    except subprocess.TimeoutExpired:
+        child_note = f"child killed at {budget:.0f}s deadline (hang mid-stage)"
+    except Exception as e:  # noqa: BLE001
+        child_note = f"child spawn failed: {type(e).__name__}: {e}"
+    try:
+        with open(state.name) as f:
+            rec = json.load(f)
+    except Exception as e:  # noqa: BLE001
+        rec = _empty_record(f"no state file from child: {type(e).__name__}: {e}")
+    finally:
+        try:
+            os.unlink(state.name)
+        except OSError:
+            pass
+    rec["probe_attempts"] = attempts
+    if child_note:
+        rec["note"] = f"{rec.get('note', '')}; {child_note}".lstrip("; ")
+    print(json.dumps(rec))
+    return 0 if rec.get("value", 0.0) > 0.0 else 1
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        sys.exit(_Child(sys.argv[2], float(sys.argv[3])).run())
     sys.exit(main())

@@ -36,7 +36,11 @@ class Geometry:
     @classmethod
     def of(cls, dist: Distribution) -> "Geometry":
         if dist.source_rank != (0, 0):
-            raise NotImplementedError("algorithms require source_rank == (0,0) for now")
+            raise NotImplementedError(
+                "SPMD kernels assume source_rank == (0,0); distribute the "
+                "matrix over grid.rolled(sr, sc) instead — identical physical "
+                "placement with origin-(0,0) indexing (see Grid.rolled)"
+            )
         return cls(
             m=dist.size.rows,
             n=dist.size.cols,

@@ -139,6 +139,7 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
         band_to_tridiagonal_hh_storage,
         band_to_tridiagonal_storage,
         extract_band_storage,
+        resolve_chase_backend,
     )
     from dlaf_tpu.native import get_lib
 
@@ -147,7 +148,10 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
     if m == 0:
         return None, None
     b2 = _sbr_target(band)
-    if b2 and get_lib() is not None:
+    # a chase backend exists if the native lib built OR the device
+    # wavefront kernel is selected (the latter needs no toolchain)
+    chase_ok = get_lib() is not None or resolve_chase_backend() == "device"
+    if b2 and chase_ok:
         from dlaf_tpu.algorithms.band_reduction import sbr_reduce
 
         ab = extract_band_storage(band_mat, band)
@@ -158,7 +162,7 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
         return band_to_tridiagonal_storage(ab2, b2, dt), None
     if want_q:
         return band_to_tridiagonal_hh(band_mat, band=band), None
-    if get_lib() is not None:
+    if chase_ok:
         return (
             band_to_tridiagonal_storage(extract_band_storage(band_mat, band), band, dt),
             None,

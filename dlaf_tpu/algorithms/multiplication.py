@@ -484,6 +484,19 @@ def general_sub_multiplication(
         return mat_c
     if mat_c.grid.grid_size.count() == 1:
         return _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref)
+    if not (a_ref.aligned and b_ref.aligned and c_ref.aligned):
+        # Non-tile-aligned distributed windows (reference: MatrixRef at any
+        # element origin, matrix_ref.h:39): realign on device — O(window)
+        # ppermute neighbor shifts (matrix/window.py), the SPMD equivalent
+        # of the reference's in-tile SubTileSpec offsets — run the aligned
+        # kernel, and write the C window back through its parent.
+        from dlaf_tpu.matrix.window import window_extract, window_update
+
+        wa = window_extract(mat_a, tuple(a_ref.origin), tuple(a_ref.size))
+        wb = window_extract(mat_b, tuple(b_ref.origin), tuple(b_ref.size))
+        wc = window_extract(mat_c, tuple(c_ref.origin), tuple(c_ref.size))
+        out = general_multiplication(t.NO_TRANS, t.NO_TRANS, alpha, wa, wb, beta, wc)
+        return window_update(mat_c, tuple(c_ref.origin), out)
     L = min(g_c.ltr, -(-Ri // g_c.pr))
     Cw = min(g_c.ltc, -(-Rj // g_c.pc))
     origins = (

@@ -35,11 +35,22 @@ def build_shim() -> str | None:
     with _lock:
         inc = sysconfig.get_paths()["include"]
         libdir = sysconfig.get_config_var("LIBDIR") or ""
-        pylib = (sysconfig.get_config_var("LDLIBRARY") or "").replace(
-            ".so", ""
-        ).replace("lib", "", 1)
-        if not pylib:
-            return None
+        # Link name: prefer LDVERSION ("3.11", "3.13t", ...) — robust against
+        # versioned sonames (libpython3.11.so.1.0) and static-only builds
+        # where stripping suffixes off LDLIBRARY mangles the -l name.
+        ldver = sysconfig.get_config_var("LDVERSION")
+        if ldver:
+            pylib = f"python{ldver}"
+        else:
+            import re
+
+            m = re.match(
+                r"lib(.+?)(?:\.so(?:\.\d+)*|\.a|\.dylib)$",
+                sysconfig.get_config_var("LDLIBRARY") or "",
+            )
+            if not m:
+                return None
+            pylib = m.group(1)
         flags = [
             "-O2", "-std=c++17", f"-I{inc}",
             f"-L{libdir}", f"-l{pylib}", f"-Wl,-rpath,{libdir}",

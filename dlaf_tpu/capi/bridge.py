@@ -9,7 +9,6 @@ the full global buffer wrapped into DistributedMatrix.from_global).
 from __future__ import annotations
 
 import ctypes
-import os
 import sys
 import traceback
 
@@ -22,7 +21,9 @@ def _setup_jax(dtype: np.dtype) -> None:
     from dlaf_tpu.common.nativebuild import honor_jax_platforms_env
 
     honor_jax_platforms_env()
-    if np.dtype(dtype).itemsize >= 8:
+    if np.dtype(dtype).itemsize >= 8 and np.dtype(dtype).kind != "c":
+        jax.config.update("jax_enable_x64", True)
+    if np.dtype(dtype) in (np.complex128,):
         jax.config.update("jax_enable_x64", True)
 
 
@@ -37,11 +38,32 @@ def _view(addr: int, desc, dtype) -> np.ndarray:
     return full[: int(m), :]  # writable (frombuffer of a ctypes array)
 
 
+def _wview(addr: int, count: int, dtype) -> np.ndarray:
+    """Writable view of the (always real) eigenvalue buffer."""
+    rdt = np.empty(0, dtype=dtype).real.dtype
+    buf = (ctypes.c_char * (count * rdt.itemsize)).from_address(addr)
+    return np.frombuffer(buf, dtype=rdt)
+
+
 def _descriptor(desc):
     from dlaf_tpu.scalapack.api import Descriptor
 
     _, _, m, n, mb, nb, rsrc, csrc, _ = desc
     return Descriptor(int(m), int(n), int(mb), int(nb), int(rsrc), int(csrc))
+
+
+def _write_triangle(a: np.ndarray, out: np.ndarray, uplo: str, strict: bool = False) -> None:
+    """ScaLAPACK triangle semantics: only the operated triangle is written;
+    the caller's opposite triangle (and, for ``strict``, the diagonal — the
+    unit-diag trtri case) is left untouched."""
+    if str(uplo).upper() == "L":
+        a[:, :] = np.tril(out, -1 if strict else 0) + np.triu(a, 0 if strict else 1)
+    else:
+        a[:, :] = np.triu(out, 1 if strict else 0) + np.tril(a, 0 if strict else -1)
+
+
+def _scalar(re: float, im: float, dtype) -> complex | float:
+    return complex(re, im) if np.dtype(dtype).kind == "c" else re
 
 
 def c_create_grid(nprow: int, npcol: int) -> int:
@@ -66,29 +88,110 @@ def c_free_grid(ctx: int) -> int:
         return -1
 
 
-def c_potrf(uplo: str, addr: int, desc, dtype_str: str) -> int:
+def c_potrf(uplo: str, diag: str, addr: int, desc, dtype_str: str) -> int:
     try:
         dtype = np.dtype(dtype_str)
         _setup_jax(dtype)
         from dlaf_tpu.scalapack.api import ppotrf
 
         a = _view(addr, desc, dtype)
-        ctx = int(desc[1])
-        out = ppotrf(ctx, str(uplo), np.ascontiguousarray(a), _descriptor(desc))
-        # ScaLAPACK p?potrf semantics: only the factored triangle is
-        # written; the caller's opposite triangle is left untouched
-        if str(uplo).upper() == "L":
-            a[:, :] = np.tril(out) + np.triu(a, 1)
-        else:
-            a[:, :] = np.triu(out) + np.tril(a, -1)
+        out = ppotrf(int(desc[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desc))
+        _write_triangle(a, out, uplo)
         return 0
     except Exception:
         traceback.print_exc(file=sys.stderr)
         return 1
 
 
-def c_syevd(uplo: str, a_addr: int, desca, w_addr: int, z_addr: int,
-            descz, dtype_str: str) -> int:
+def c_potri(uplo: str, diag: str, addr: int, desc, dtype_str: str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import ppotri
+
+        a = _view(addr, desc, dtype)
+        out = ppotri(int(desc[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desc))
+        _write_triangle(a, out, uplo)
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_trtri(uplo: str, diag: str, addr: int, desc, dtype_str: str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import ptrtri
+
+        a = _view(addr, desc, dtype)
+        out = ptrtri(
+            int(desc[1]), str(uplo), str(diag), np.ascontiguousarray(a), _descriptor(desc)
+        )
+        # unit-diag trtri neither reads nor writes the diagonal
+        _write_triangle(a, out, uplo, strict=str(diag).upper() == "U")
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_trsm(side, uplo, trans, diag, are, aim, a_addr, desca, b_addr, descb, dtype_str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import ptrsm
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        out = ptrsm(
+            int(desca[1]), str(side), str(uplo), str(trans), str(diag),
+            _scalar(are, aim, dtype), np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+        )
+        b[:, :] = out
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_gemm(
+    transa, transb, are, aim, a_addr, desca, b_addr, descb, bre, bim,
+    c_addr, descc, dtype_str,
+) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import pgemm
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        c = _view(c_addr, descc, dtype)
+        out = pgemm(
+            int(desca[1]), str(transa), str(transb), _scalar(are, aim, dtype),
+            np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+            _scalar(bre, bim, dtype), np.ascontiguousarray(c), _descriptor(descc),
+        )
+        c[:, :] = out
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def _spectrum(n: int, il: int, iu: int):
+    """Map the C ABI's 1-based inclusive [il, iu] (0,0 = full) to the
+    scalapack layer's 0-based spectrum tuple."""
+    if il <= 0 and iu <= 0:
+        return None
+    if not (1 <= il <= iu <= n):
+        raise ValueError(f"partial spectrum [{il}, {iu}] invalid for n={n}")
+    return (int(il) - 1, int(iu) - 1)
+
+
+def c_syevd(uplo, a_addr, desca, w_addr, z_addr, descz, dtype_str, il=0, iu=0) -> int:
     try:
         dtype = np.dtype(dtype_str)
         _setup_jax(dtype)
@@ -96,14 +199,43 @@ def c_syevd(uplo: str, a_addr: int, desca, w_addr: int, z_addr: int,
 
         a = _view(a_addr, desca, dtype)
         z = _view(z_addr, descz, dtype)
-        m = int(desca[2])
-        wbytes = m * np.dtype(dtype).itemsize
-        wbuf = (ctypes.c_char * wbytes).from_address(w_addr)
-        w = np.frombuffer(wbuf, dtype=dtype)
-        ctx = int(desca[1])
-        ev, evec = pheevd(ctx, str(uplo), np.ascontiguousarray(a), _descriptor(desca))
-        w[:] = ev.astype(dtype, copy=False)
-        z[:, :] = evec
+        n = int(desca[2])
+        spectrum = _spectrum(n, int(il), int(iu))
+        k = n if spectrum is None else spectrum[1] - spectrum[0] + 1
+        ev, evec = pheevd(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            spectrum=spectrum,
+        )
+        _wview(w_addr, k, dtype)[:] = ev
+        z[:, :k] = evec
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_sygvd(
+    uplo, a_addr, desca, b_addr, descb, w_addr, z_addr, descz, dtype_str,
+    il=0, iu=0, factorized=0,
+) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import phegvd
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        z = _view(z_addr, descz, dtype)
+        n = int(desca[2])
+        spectrum = _spectrum(n, int(il), int(iu))
+        k = n if spectrum is None else spectrum[1] - spectrum[0] + 1
+        ev, evec = phegvd(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+            spectrum=spectrum, factorized=bool(factorized),
+        )
+        _wview(w_addr, k, dtype)[:] = ev
+        z[:, :k] = evec
         return 0
     except Exception:
         traceback.print_exc(file=sys.stderr)

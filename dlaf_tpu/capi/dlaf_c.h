@@ -2,9 +2,11 @@
  *
  * TPU-native analogue of the reference C API
  * (reference: include/dlaf_c/grid.h:31-77, include/dlaf_c/desc.h,
- * include/dlaf_c/factorization/cholesky.h, include/dlaf_c/eigensolver/
- * eigensolver.h:36-119).  Differences, owed to the single-controller
- * execution model (no MPI in the loop):
+ * include/dlaf_c/factorization/cholesky.h, include/dlaf_c/inverse/
+ * inverse_from_cholesky_factor.h, include/dlaf_c/eigensolver/
+ * eigensolver.h:36-157, include/dlaf_c/eigensolver/gen_eigensolver.h).
+ * Differences, owed to the single-controller execution model (no MPI in
+ * the loop):
  *
  *  - matrices are passed as the FULL GLOBAL column-major buffer (in real
  *    ScaLAPACK the per-rank local block-cyclic buffer); the block-cyclic
@@ -12,12 +14,16 @@
  *  - dlaf_create_grid takes (nprow, npcol) directly instead of an MPI
  *    communicator / BLACS context,
  *  - routines RETURN the info code instead of writing through an out
- *    pointer.
+ *    pointer (0 = success),
+ *  - the ia/ja/iz/jz submatrix indices of the reference signatures (which
+ *    it requires to be 1 anyway, eigensolver.h:94-113) are omitted.
  *
  * desc9 follows the ScaLAPACK DESC_ layout:
  *   [ dtype_, ctxt_, m_, n_, mb_, nb_, rsrc_, csrc_, lld_ ]
  * where ctxt_ is the context returned by dlaf_create_grid and lld_ >= m_
- * is the leading dimension of the column-major buffer.
+ * is the leading dimension of the column-major buffer.  Nonzero
+ * rsrc_/csrc_ place the first block on that grid rank (realized via a
+ * rolled device mesh; all descriptors of one call must agree on it).
  *
  * The implementing shared library embeds a CPython interpreter; the
  * dlaf_tpu package must be importable (set PYTHONPATH accordingly).
@@ -25,8 +31,16 @@
 #ifndef DLAF_TPU_C_H
 #define DLAF_TPU_C_H
 
+/* Complex typedefs, following the reference dlaf_c/utils.h:24-30. */
 #ifdef __cplusplus
+#include <complex>
+typedef std::complex<float> dlaf_complex_c;
+typedef std::complex<double> dlaf_complex_z;
 extern "C" {
+#else
+#include <complex.h>
+typedef float complex dlaf_complex_c;
+typedef double complex dlaf_complex_z;
 #endif
 
 /* Initialize the embedded interpreter + JAX runtime (idempotent; called
@@ -41,18 +55,145 @@ void dlaf_tpu_finalize(void);
 int dlaf_create_grid(int nprow, int npcol);
 void dlaf_free_grid(int ctx);
 
-/* Cholesky factorization, lower/upper per uplo ('L'/'U').
- * (reference: dlaf_c/factorization/cholesky.h dlaf_p{s,d}potrf) */
+/* ---- Cholesky factorization (uplo 'L'/'U'; only the factored triangle
+ * of a is written).  (reference: dlaf_c/factorization/cholesky.h) ---- */
 int dlaf_pspotrf(char uplo, float* a, const int desca[9]);
 int dlaf_pdpotrf(char uplo, double* a, const int desca[9]);
+int dlaf_pcpotrf(char uplo, dlaf_complex_c* a, const int desca[9]);
+int dlaf_pzpotrf(char uplo, dlaf_complex_z* a, const int desca[9]);
 
-/* Hermitian/symmetric eigensolver: eigenvalues into w[0..m), eigenvectors
- * into z (column-major, descz).  (reference: dlaf_c/eigensolver/
- * eigensolver.h dlaf_p{s,d}syevd) */
+/* ---- Inverse from the Cholesky factor: a holds the factor on entry, the
+ * uplo triangle of A^-1 on exit.  (reference: dlaf_c/inverse/
+ * inverse_from_cholesky_factor.h dlaf_p*potri) ---- */
+int dlaf_pspotri(char uplo, float* a, const int desca[9]);
+int dlaf_pdpotri(char uplo, double* a, const int desca[9]);
+int dlaf_pcpotri(char uplo, dlaf_complex_c* a, const int desca[9]);
+int dlaf_pzpotri(char uplo, dlaf_complex_z* a, const int desca[9]);
+
+/* ---- Triangular matrix inverse in place (diag 'U' unit / 'N'). ---- */
+int dlaf_pstrtri(char uplo, char diag, float* a, const int desca[9]);
+int dlaf_pdtrtri(char uplo, char diag, double* a, const int desca[9]);
+int dlaf_pctrtri(char uplo, char diag, dlaf_complex_c* a, const int desca[9]);
+int dlaf_pztrtri(char uplo, char diag, dlaf_complex_z* a, const int desca[9]);
+
+/* ---- Triangular solve: op(A) X = alpha B (side 'L') or X op(A) =
+ * alpha B (side 'R'); B is overwritten with X.  trans 'N'/'T'/'C'. ---- */
+int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,
+                float* a, const int desca[9], float* b, const int descb[9]);
+int dlaf_pdtrsm(char side, char uplo, char trans, char diag, double alpha,
+                double* a, const int desca[9], double* b, const int descb[9]);
+int dlaf_pctrsm(char side, char uplo, char trans, char diag,
+                const dlaf_complex_c* alpha, dlaf_complex_c* a,
+                const int desca[9], dlaf_complex_c* b, const int descb[9]);
+int dlaf_pztrsm(char side, char uplo, char trans, char diag,
+                const dlaf_complex_z* alpha, dlaf_complex_z* a,
+                const int desca[9], dlaf_complex_z* b, const int descb[9]);
+
+/* ---- General matrix multiply: C = alpha op(A) op(B) + beta C. ---- */
+int dlaf_psgemm(char transa, char transb, float alpha, float* a,
+                const int desca[9], float* b, const int descb[9], float beta,
+                float* c, const int descc[9]);
+int dlaf_pdgemm(char transa, char transb, double alpha, double* a,
+                const int desca[9], double* b, const int descb[9], double beta,
+                double* c, const int descc[9]);
+int dlaf_pcgemm(char transa, char transb, const dlaf_complex_c* alpha,
+                dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b,
+                const int descb[9], const dlaf_complex_c* beta,
+                dlaf_complex_c* c, const int descc[9]);
+int dlaf_pzgemm(char transa, char transb, const dlaf_complex_z* alpha,
+                dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b,
+                const int descb[9], const dlaf_complex_z* beta,
+                dlaf_complex_z* c, const int descc[9]);
+
+/* ---- Hermitian/symmetric eigensolver: eigenvalues (always real) into
+ * w[0..n), eigenvectors into z (column-major, descz).  (reference:
+ * dlaf_c/eigensolver/eigensolver.h dlaf_p{s,d}syevd / dlaf_p{c,z}heevd)
+ * The _partial_spectrum variants compute eigenvalue indices
+ * [il, iu] (1-based, inclusive, like the reference's
+ * eigenvalues_index_begin/end, eigensolver.h:121-127); the iu-il+1
+ * eigenvalues land in w[0..iu-il] and eigenvectors in the first iu-il+1
+ * columns of z. ---- */
 int dlaf_pssyevd(char uplo, float* a, const int desca[9], float* w,
                  float* z, const int descz[9]);
 int dlaf_pdsyevd(char uplo, double* a, const int desca[9], double* w,
                  double* z, const int descz[9]);
+int dlaf_pcheevd(char uplo, dlaf_complex_c* a, const int desca[9], float* w,
+                 dlaf_complex_c* z, const int descz[9]);
+int dlaf_pzheevd(char uplo, dlaf_complex_z* a, const int desca[9], double* w,
+                 dlaf_complex_z* z, const int descz[9]);
+int dlaf_pssyevd_partial_spectrum(char uplo, float* a, const int desca[9],
+                                  float* w, float* z, const int descz[9],
+                                  long il, long iu);
+int dlaf_pdsyevd_partial_spectrum(char uplo, double* a, const int desca[9],
+                                  double* w, double* z, const int descz[9],
+                                  long il, long iu);
+int dlaf_pcheevd_partial_spectrum(char uplo, dlaf_complex_c* a,
+                                  const int desca[9], float* w,
+                                  dlaf_complex_c* z, const int descz[9],
+                                  long il, long iu);
+int dlaf_pzheevd_partial_spectrum(char uplo, dlaf_complex_z* a,
+                                  const int desca[9], double* w,
+                                  dlaf_complex_z* z, const int descz[9],
+                                  long il, long iu);
+
+/* ---- Generalized eigensolver A x = lambda B x: a holds A (uplo
+ * triangle), b holds the SPD B — or its Cholesky factor for the
+ * _factorized variants (reference dlaf_p*{sy,he}gvd[_factorized],
+ * gen_eigensolver.h).  Partial-spectrum variants as above. ---- */
+int dlaf_pssygvd(char uplo, float* a, const int desca[9], float* b,
+                 const int descb[9], float* w, float* z, const int descz[9]);
+int dlaf_pdsygvd(char uplo, double* a, const int desca[9], double* b,
+                 const int descb[9], double* w, double* z, const int descz[9]);
+int dlaf_pchegvd(char uplo, dlaf_complex_c* a, const int desca[9],
+                 dlaf_complex_c* b, const int descb[9], float* w,
+                 dlaf_complex_c* z, const int descz[9]);
+int dlaf_pzhegvd(char uplo, dlaf_complex_z* a, const int desca[9],
+                 dlaf_complex_z* b, const int descb[9], double* w,
+                 dlaf_complex_z* z, const int descz[9]);
+int dlaf_pssygvd_factorized(char uplo, float* a, const int desca[9], float* b,
+                            const int descb[9], float* w, float* z,
+                            const int descz[9]);
+int dlaf_pdsygvd_factorized(char uplo, double* a, const int desca[9],
+                            double* b, const int descb[9], double* w,
+                            double* z, const int descz[9]);
+int dlaf_pchegvd_factorized(char uplo, dlaf_complex_c* a, const int desca[9],
+                            dlaf_complex_c* b, const int descb[9], float* w,
+                            dlaf_complex_c* z, const int descz[9]);
+int dlaf_pzhegvd_factorized(char uplo, dlaf_complex_z* a, const int desca[9],
+                            dlaf_complex_z* b, const int descb[9], double* w,
+                            dlaf_complex_z* z, const int descz[9]);
+int dlaf_pssygvd_partial_spectrum(char uplo, float* a, const int desca[9],
+                                  float* b, const int descb[9], float* w,
+                                  float* z, const int descz[9], long il,
+                                  long iu);
+int dlaf_pdsygvd_partial_spectrum(char uplo, double* a, const int desca[9],
+                                  double* b, const int descb[9], double* w,
+                                  double* z, const int descz[9], long il,
+                                  long iu);
+int dlaf_pchegvd_partial_spectrum(char uplo, dlaf_complex_c* a,
+                                  const int desca[9], dlaf_complex_c* b,
+                                  const int descb[9], float* w,
+                                  dlaf_complex_c* z, const int descz[9],
+                                  long il, long iu);
+int dlaf_pzhegvd_partial_spectrum(char uplo, dlaf_complex_z* a,
+                                  const int desca[9], dlaf_complex_z* b,
+                                  const int descb[9], double* w,
+                                  dlaf_complex_z* z, const int descz[9],
+                                  long il, long iu);
+int dlaf_pssygvd_partial_spectrum_factorized(
+    char uplo, float* a, const int desca[9], float* b, const int descb[9],
+    float* w, float* z, const int descz[9], long il, long iu);
+int dlaf_pdsygvd_partial_spectrum_factorized(
+    char uplo, double* a, const int desca[9], double* b, const int descb[9],
+    double* w, double* z, const int descz[9], long il, long iu);
+int dlaf_pchegvd_partial_spectrum_factorized(
+    char uplo, dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b,
+    const int descb[9], float* w, dlaf_complex_c* z, const int descz[9],
+    long il, long iu);
+int dlaf_pzhegvd_partial_spectrum_factorized(
+    char uplo, dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b,
+    const int descb[9], double* w, dlaf_complex_z* z, const int descz[9],
+    long il, long iu);
 
 #ifdef __cplusplus
 }

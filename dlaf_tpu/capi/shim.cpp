@@ -69,30 +69,84 @@ static PyObject* desc_tuple(const int d[9]) {
   return t;
 }
 
-static int run_potrf(char uplo, void* a, const int desca[9], const char* dt) {
+/* Run bridge fn with pre-built args; extract the int info code. */
+static int run_info(const char* fn, PyObject* args) {
+  PyObject* r = call_bridge(fn, args);
+  int info = r ? (int)PyLong_AsLong(r) : -1;
+  Py_XDECREF(r);
+  return info;
+}
+
+/* ---- generic runners (one per argument shape) ---- */
+
+/* in-place single-matrix triangle op: potrf / potri / trtri */
+static int run_tri(const char* fn, char uplo, char diag, void* a,
+                   const int desca[9], const char* dt) {
   dlaf_tpu_init();
   PyGILState_STATE st = PyGILState_Ensure();
   PyObject* args = Py_BuildValue(
-      "(CKNs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      "(CCKNs)", (int)uplo, (int)diag, (unsigned long long)(uintptr_t)a,
       desc_tuple(desca), dt);
-  PyObject* r = call_bridge("c_potrf", args);
-  int info = r ? (int)PyLong_AsLong(r) : -1;
-  Py_XDECREF(r);
+  int info = run_info(fn, args);
   PyGILState_Release(st);
   return info;
 }
 
-static int run_syevd(char uplo, void* a, const int desca[9], void* w,
-                     void* z, const int descz[9], const char* dt) {
+static int run_trsm(char side, char uplo, char trans, char diag, double are,
+                    double aim, void* a, const int desca[9], void* b,
+                    const int descb[9], const char* dt) {
   dlaf_tpu_init();
   PyGILState_STATE st = PyGILState_Ensure();
   PyObject* args = Py_BuildValue(
-      "(CKNKKNs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      "(CCCCddKNKNs)", (int)side, (int)uplo, (int)trans, (int)diag, are, aim,
+      (unsigned long long)(uintptr_t)a, desc_tuple(desca),
+      (unsigned long long)(uintptr_t)b, desc_tuple(descb), dt);
+  int info = run_info("c_trsm", args);
+  PyGILState_Release(st);
+  return info;
+}
+
+static int run_gemm(char transa, char transb, double are, double aim, void* a,
+                    const int desca[9], void* b, const int descb[9],
+                    double bre, double bim, void* c, const int descc[9],
+                    const char* dt) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CCddKNKNddKNs)", (int)transa, (int)transb, are, aim,
+      (unsigned long long)(uintptr_t)a, desc_tuple(desca),
+      (unsigned long long)(uintptr_t)b, desc_tuple(descb), bre, bim,
+      (unsigned long long)(uintptr_t)c, desc_tuple(descc), dt);
+  int info = run_info("c_gemm", args);
+  PyGILState_Release(st);
+  return info;
+}
+
+/* syevd/heevd: il/iu are 1-based inclusive; 0,0 = full spectrum */
+static int run_syevd(char uplo, void* a, const int desca[9], void* w, void* z,
+                     const int descz[9], const char* dt, long il, long iu) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKKNsll)", (int)uplo, (unsigned long long)(uintptr_t)a,
       desc_tuple(desca), (unsigned long long)(uintptr_t)w,
-      (unsigned long long)(uintptr_t)z, desc_tuple(descz), dt);
-  PyObject* r = call_bridge("c_syevd", args);
-  int info = r ? (int)PyLong_AsLong(r) : -1;
-  Py_XDECREF(r);
+      (unsigned long long)(uintptr_t)z, desc_tuple(descz), dt, il, iu);
+  int info = run_info("c_syevd", args);
+  PyGILState_Release(st);
+  return info;
+}
+
+static int run_sygvd(char uplo, void* a, const int desca[9], void* b,
+                     const int descb[9], void* w, void* z, const int descz[9],
+                     const char* dt, long il, long iu, int factorized) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKNKKNslli)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), (unsigned long long)(uintptr_t)b, desc_tuple(descb),
+      (unsigned long long)(uintptr_t)w, (unsigned long long)(uintptr_t)z,
+      desc_tuple(descz), dt, il, iu, factorized);
+  int info = run_info("c_sygvd", args);
   PyGILState_Release(st);
   return info;
 }
@@ -117,17 +171,110 @@ void dlaf_free_grid(int ctx) {
   PyGILState_Release(st);
 }
 
-int dlaf_pspotrf(char uplo, float* a, const int desca[9]) {
-  return run_potrf(uplo, a, desca, "f4");
+/* ---- exported wrappers, macro-generated per dtype ----
+ * X(suffix, ctype, real_ctype, dtstr): s/d pass alpha by value and use
+ * real==element type; c/z pass alpha by pointer and have real w. */
+
+#define DLAF_TRI_FAMILY(suffix, ctype, dtstr)                             \
+  int dlaf_p##suffix##potrf(char uplo, ctype* a, const int desca[9]) {    \
+    return run_tri("c_potrf", uplo, 'N', a, desca, dtstr);                \
+  }                                                                       \
+  int dlaf_p##suffix##potri(char uplo, ctype* a, const int desca[9]) {    \
+    return run_tri("c_potri", uplo, 'N', a, desca, dtstr);                \
+  }                                                                       \
+  int dlaf_p##suffix##trtri(char uplo, char diag, ctype* a,               \
+                            const int desca[9]) {                         \
+    return run_tri("c_trtri", uplo, diag, a, desca, dtstr);               \
+  }
+
+DLAF_TRI_FAMILY(s, float, "f4")
+DLAF_TRI_FAMILY(d, double, "f8")
+DLAF_TRI_FAMILY(c, dlaf_complex_c, "c8")
+DLAF_TRI_FAMILY(z, dlaf_complex_z, "c16")
+
+int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,
+                float* a, const int desca[9], float* b, const int descb[9]) {
+  return run_trsm(side, uplo, trans, diag, alpha, 0.0, a, desca, b, descb, "f4");
 }
-int dlaf_pdpotrf(char uplo, double* a, const int desca[9]) {
-  return run_potrf(uplo, a, desca, "f8");
+int dlaf_pdtrsm(char side, char uplo, char trans, char diag, double alpha,
+                double* a, const int desca[9], double* b, const int descb[9]) {
+  return run_trsm(side, uplo, trans, diag, alpha, 0.0, a, desca, b, descb, "f8");
 }
-int dlaf_pssyevd(char uplo, float* a, const int desca[9], float* w, float* z,
-                 const int descz[9]) {
-  return run_syevd(uplo, a, desca, w, z, descz, "f4");
+int dlaf_pctrsm(char side, char uplo, char trans, char diag,
+                const dlaf_complex_c* alpha, dlaf_complex_c* a,
+                const int desca[9], dlaf_complex_c* b, const int descb[9]) {
+  return run_trsm(side, uplo, trans, diag, alpha->real(), alpha->imag(), a,
+                  desca, b, descb, "c8");
 }
-int dlaf_pdsyevd(char uplo, double* a, const int desca[9], double* w,
-                 double* z, const int descz[9]) {
-  return run_syevd(uplo, a, desca, w, z, descz, "f8");
+int dlaf_pztrsm(char side, char uplo, char trans, char diag,
+                const dlaf_complex_z* alpha, dlaf_complex_z* a,
+                const int desca[9], dlaf_complex_z* b, const int descb[9]) {
+  return run_trsm(side, uplo, trans, diag, alpha->real(), alpha->imag(), a,
+                  desca, b, descb, "c16");
 }
+
+int dlaf_psgemm(char transa, char transb, float alpha, float* a,
+                const int desca[9], float* b, const int descb[9], float beta,
+                float* c, const int descc[9]) {
+  return run_gemm(transa, transb, alpha, 0.0, a, desca, b, descb, beta, 0.0, c,
+                  descc, "f4");
+}
+int dlaf_pdgemm(char transa, char transb, double alpha, double* a,
+                const int desca[9], double* b, const int descb[9], double beta,
+                double* c, const int descc[9]) {
+  return run_gemm(transa, transb, alpha, 0.0, a, desca, b, descb, beta, 0.0, c,
+                  descc, "f8");
+}
+int dlaf_pcgemm(char transa, char transb, const dlaf_complex_c* alpha,
+                dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b,
+                const int descb[9], const dlaf_complex_c* beta,
+                dlaf_complex_c* c, const int descc[9]) {
+  return run_gemm(transa, transb, alpha->real(), alpha->imag(), a, desca, b,
+                  descb, beta->real(), beta->imag(), c, descc, "c8");
+}
+int dlaf_pzgemm(char transa, char transb, const dlaf_complex_z* alpha,
+                dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b,
+                const int descb[9], const dlaf_complex_z* beta,
+                dlaf_complex_z* c, const int descc[9]) {
+  return run_gemm(transa, transb, alpha->real(), alpha->imag(), a, desca, b,
+                  descb, beta->real(), beta->imag(), c, descc, "c16");
+}
+
+#define DLAF_EV_FAMILY(ev, gv, ctype, wtype, dtstr)                           \
+  int dlaf_p##ev(char uplo, ctype* a, const int desca[9], wtype* w, ctype* z, \
+                 const int descz[9]) {                                        \
+    return run_syevd(uplo, a, desca, w, z, descz, dtstr, 0, 0);               \
+  }                                                                           \
+  int dlaf_p##ev##_partial_spectrum(char uplo, ctype* a, const int desca[9],  \
+                                    wtype* w, ctype* z, const int descz[9],   \
+                                    long il, long iu) {                       \
+    return run_syevd(uplo, a, desca, w, z, descz, dtstr, il, iu);             \
+  }                                                                           \
+  int dlaf_p##gv(char uplo, ctype* a, const int desca[9], ctype* b,           \
+                 const int descb[9], wtype* w, ctype* z,                      \
+                 const int descz[9]) {                                        \
+    return run_sygvd(uplo, a, desca, b, descb, w, z, descz, dtstr, 0, 0, 0);  \
+  }                                                                           \
+  int dlaf_p##gv##_factorized(char uplo, ctype* a, const int desca[9],        \
+                              ctype* b, const int descb[9], wtype* w,         \
+                              ctype* z, const int descz[9]) {                 \
+    return run_sygvd(uplo, a, desca, b, descb, w, z, descz, dtstr, 0, 0, 1);  \
+  }                                                                           \
+  int dlaf_p##gv##_partial_spectrum(char uplo, ctype* a, const int desca[9],  \
+                                    ctype* b, const int descb[9], wtype* w,   \
+                                    ctype* z, const int descz[9], long il,    \
+                                    long iu) {                                \
+    return run_sygvd(uplo, a, desca, b, descb, w, z, descz, dtstr, il, iu,    \
+                     0);                                                      \
+  }                                                                           \
+  int dlaf_p##gv##_partial_spectrum_factorized(                               \
+      char uplo, ctype* a, const int desca[9], ctype* b, const int descb[9],  \
+      wtype* w, ctype* z, const int descz[9], long il, long iu) {             \
+    return run_sygvd(uplo, a, desca, b, descb, w, z, descz, dtstr, il, iu,    \
+                     1);                                                      \
+  }
+
+DLAF_EV_FAMILY(ssyevd, ssygvd, float, float, "f4")
+DLAF_EV_FAMILY(dsyevd, dsygvd, double, double, "f8")
+DLAF_EV_FAMILY(cheevd, chegvd, dlaf_complex_c, float, "c8")
+DLAF_EV_FAMILY(zheevd, zhegvd, dlaf_complex_z, double, "c16")

@@ -61,6 +61,27 @@ class Grid:
         dev = np.asarray(devices[: shape.count()]).reshape(shape.rows, shape.cols)
         return cls(Mesh(dev, (ROW_AXIS, COL_AXIS)))
 
+    def rolled(self, roll_r: int, roll_c: int) -> "Grid":
+        """Grid over the SAME devices with mesh coordinates rolled so that
+        this grid's rank ``(roll_r, roll_c)`` becomes rank ``(0, 0)``.
+
+        This is how nonzero source ranks reach the SPMD kernels: a matrix
+        distributed with ``source_rank=(sr, sc)`` over this grid occupies
+        exactly the same physical devices as one with ``source_rank=(0,0)``
+        over ``self.rolled(sr, sc)`` — so algorithms (which assume origin
+        (0,0)) run unchanged on the rolled grid (reference analogue:
+        Distribution::source_rank_index, matrix/distribution.h:115-137)."""
+        pr, pc = self.grid_size
+        roll_r, roll_c = roll_r % pr, roll_c % pc
+        if (roll_r, roll_c) == (0, 0):
+            return self
+        key = (roll_r, roll_c)
+        cache = self.__dict__.setdefault("_rolled_cache", {})
+        if key not in cache:
+            devs = np.roll(self.mesh.devices, shift=(-roll_r, -roll_c), axis=(0, 1))
+            cache[key] = Grid(Mesh(devs, (ROW_AXIS, COL_AXIS)))
+        return cache[key]
+
     @classmethod
     def local(cls) -> "Grid":
         """1x1 grid on the default device (reference: local algorithm variants

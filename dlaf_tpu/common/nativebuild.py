@@ -28,6 +28,21 @@ def honor_jax_platforms_env() -> None:
             pass
 
 
+def _warn_build_failure(out_so: str, last_err: str | None) -> None:
+    """A failed native build silently degrades to slow fallbacks; leave a
+    diagnosable trace (suppressible via DLAF_TPU_QUIET_BUILD=1)."""
+    if os.environ.get("DLAF_TPU_QUIET_BUILD"):
+        return
+    import warnings
+
+    warnings.warn(
+        f"native build of {os.path.basename(out_so)} failed; falling back to "
+        f"pure-Python paths. Last compiler error:\n{last_err or '(no output)'}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def atomic_build(
     sources: Sequence[str],
     out_so: str,
@@ -71,18 +86,23 @@ def atomic_build(
             return True
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=here)
         os.close(fd)
+        last_err = None
         for flags in flag_variants:
             cmd = ["g++", "-shared", "-fPIC", "-o", tmp, *sources, *flags]
             try:
                 r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
-            except Exception:
+            except Exception as e:
+                last_err = f"{cmd[0]}: {e}"
                 continue
             if r.returncode == 0:
                 os.chmod(tmp, 0o755)
                 os.rename(tmp, out_so)
                 return True
+            last_err = r.stderr.strip()[-2000:]
+        _warn_build_failure(out_so, last_err)
         return False
-    except Exception:
+    except Exception as e:
+        _warn_build_failure(out_so, repr(e))
         return False
     finally:
         if tmp is not None and os.path.exists(tmp):

@@ -24,13 +24,15 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 @dataclass(frozen=True)
 class MatrixRef:
-    """A tile-aligned rectangular window of ``parent``.
+    """A rectangular window of ``parent`` at ANY element origin.
 
-    ``origin`` is the element offset (must be tile-aligned); ``size`` the
-    element extent.  The extent must either be a multiple of the tile size
-    or reach the parent's edge in that dimension (interior partial tiles
-    would break the shared tiling — same constraint as the reference's
-    tile-grid-aligned sub-matrices, matrix_ref.h:39).
+    ``origin`` is the element offset; ``size`` the element extent — like the
+    reference's ``MatrixRef`` (matrix_ref.h:39), origins need NOT be
+    tile-aligned.  Aligned windows (``.aligned``) share the parent's tiling
+    and take the fast in-kernel windowed paths; non-aligned windows are
+    realized by the O(window) device-side realignment of
+    ``matrix/window.py`` (ppermute neighbor shifts — the SPMD equivalent of
+    the reference's in-tile SubTileSpec pointer offsets, views.h:26-187).
     """
 
     parent: DistributedMatrix
@@ -40,9 +42,6 @@ class MatrixRef:
     def __init__(self, parent: DistributedMatrix, origin, size):
         origin = Index2D(*(int(v) for v in origin))
         size = Size2D(*(int(v) for v in size))
-        mb, nb = parent.block_size
-        if origin.row % mb or origin.col % nb:
-            raise ValueError(f"MatrixRef origin {tuple(origin)} not tile-aligned ({mb}x{nb})")
         if (
             origin.row < 0
             or origin.col < 0
@@ -52,17 +51,25 @@ class MatrixRef:
             raise ValueError(
                 f"MatrixRef {tuple(origin)}+{tuple(size)} out of bounds {tuple(parent.size)}"
             )
-        for ext, blk, off, tot in (
-            (size.rows, mb, origin.row, parent.size.rows),
-            (size.cols, nb, origin.col, parent.size.cols),
-        ):
-            if ext % blk and off + ext != tot:
-                raise ValueError(
-                    "MatrixRef extent must be a tile multiple or reach the parent edge"
-                )
         object.__setattr__(self, "parent", parent)
         object.__setattr__(self, "origin", origin)
         object.__setattr__(self, "size", size)
+
+    @property
+    def aligned(self) -> bool:
+        """True when the window shares the parent's tile grid: origin on a
+        tile boundary AND extent a tile multiple or reaching the parent
+        edge (interior partial tiles break shared tiling)."""
+        mb, nb = self.parent.block_size
+        if self.origin.row % mb or self.origin.col % nb:
+            return False
+        for ext, blk, off, tot in (
+            (self.size.rows, mb, self.origin.row, self.parent.size.rows),
+            (self.size.cols, nb, self.origin.col, self.parent.size.cols),
+        ):
+            if ext % blk and off + ext != tot:
+                return False
+        return True
 
     # -- geometry ---------------------------------------------------------
     @property
@@ -79,6 +86,8 @@ class MatrixRef:
 
     @property
     def tile_origin(self) -> Index2D:
+        """First parent tile touched by the window (== the exact tile origin
+        for aligned refs)."""
         return Index2D(
             self.origin.row // self.parent.block_size.rows,
             self.origin.col // self.parent.block_size.cols,

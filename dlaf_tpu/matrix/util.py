@@ -128,8 +128,9 @@ def retile(mat: DistributedMatrix, new_block_size) -> DistributedMatrix:
 
 def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
     """Sub-matrix copy at ANY element origin (reference: MatrixRef sub-matrix
-    view, matrix/matrix_ref.h:39 — tile-aligned there; we re-tile from zero,
-    functional copy instead of aliasing view)."""
+    view, matrix/matrix_ref.h:39).  Multi-device grids take the O(window)
+    ppermute realignment of :mod:`dlaf_tpu.matrix.window`; the 1x1 grid
+    slices the global form under jit (fused, no materialized copy)."""
     from functools import partial as _p
 
     import jax as _jax
@@ -146,6 +147,10 @@ def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
         or origin[1] + size[1] > mat.size.cols
     ):
         raise ValueError(f"sub-matrix {origin}+{size} out of bounds {tuple(mat.size)}")
+    if mat.grid.grid_size.count() > 1:
+        from dlaf_tpu.matrix.window import window_extract
+
+        return window_extract(mat, origin, size)
     out_dist = _D(size, mat.dist.block_size, mat.dist.grid_size)
 
     @_p(_jax.jit, static_argnums=(1, 2, 3), static_argnames=())

@@ -16,13 +16,27 @@ import jax
 import numpy as np
 
 # persistent XLA compilation cache: repeated miniapp/bench invocations skip
-# recompiles (the reference has no analogue; compiles are XLA's one-time cost)
-_cache_dir = os.environ.get("DLAF_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dlaf_tpu_xla"))
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+# recompiles (the reference has no analogue; compiles are XLA's one-time cost).
+# Partitioned by (platform, forced host device count): deserializing an
+# executable cached under a different device topology can SEGFAULT inside
+# backend.deserialize_executable, so configurations must never share a dir.
+# DLAF_TPU_COMPILE_CACHE="" disables the persistent cache entirely.
+import re as _re
+
+_cache_base = os.environ.get(
+    "DLAF_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dlaf_tpu_xla")
+)
+if _cache_base:
+    _plat = (os.environ.get("JAX_PLATFORMS") or "default").replace(",", "-")
+    _m = _re.search(
+        r"host_platform_device_count=(\d+)", os.environ.get("XLA_FLAGS", "")
+    )
+    _cache_dir = os.path.join(_cache_base, f"{_plat}-{_m.group(1) if _m else 1}")
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 from dlaf_tpu.common.nativebuild import honor_jax_platforms_env
 
@@ -67,6 +81,12 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--nwarmups", type=int, default=1)
     p.add_argument("--type", choices="sdcz", default="d")
     p.add_argument("--check", choices=["none", "last", "all"], default="none")
+    p.add_argument(
+        "--trace", default="", metavar="DIR",
+        help="capture a jax.profiler trace of timed run 0 into DIR (view "
+        "with TensorBoard / xprof; the per-stage analogue of the reference's "
+        "pika/APEX instrumentation hooks — SURVEY §5 tracing row)",
+    )
     return p
 
 
@@ -77,15 +97,24 @@ def make_grid(args) -> Grid:
 
 
 def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
-    """Warmup + timed runs with per-run report lines."""
+    """Warmup + timed runs with per-run report lines.  With ``--trace DIR``
+    the first timed run is captured by the JAX profiler (host + device
+    timelines; XLA op breakdown per pipeline stage)."""
+    trace_dir = getattr(args, "trace", "")
     results = []
     for i in range(-args.nwarmups, args.nruns):
         mat = make_input()
         sync(mat.data)
+        tracing = trace_dir and i == 0
+        if tracing:
+            jax.profiler.start_trace(trace_dir)
         t0 = time.perf_counter()
         out = run(mat)
         sync(out.data)
         dt = time.perf_counter() - t0
+        if tracing:
+            jax.profiler.stop_trace()
+            print(f"[0] trace written to {trace_dir}")
         if i < 0:
             continue
         gflops = (flops_fn(args) / dt / 1e9) if flops_fn else float("nan")

@@ -67,9 +67,31 @@ def _grid(ctx: int) -> Grid:
 def _dist(ctx: int, a: np.ndarray, desc: Descriptor) -> DistributedMatrix:
     if a.shape != (desc.m, desc.n):
         raise ValueError(f"array {a.shape} != descriptor {(desc.m, desc.n)}")
+    # Nonzero isrc/jsrc (source rank of the first block): realized by rolling
+    # the grid so the descriptor's source rank is mesh origin — identical
+    # physical placement, and the SPMD kernels (which assume origin (0,0))
+    # run unchanged (reference: matrix/distribution.h:115-137 source_rank).
+    grid = _grid(ctx)
+    pr, pc = grid.grid_size
+    if not (0 <= desc.isrc < pr and 0 <= desc.jsrc < pc):
+        raise ValueError(
+            f"descriptor source rank ({desc.isrc}, {desc.jsrc}) outside grid {pr}x{pc}"
+        )
     return DistributedMatrix.from_global(
-        _grid(ctx), a, (desc.mb, desc.nb), source_rank=(desc.isrc, desc.jsrc)
+        grid.rolled(desc.isrc, desc.jsrc), a, (desc.mb, desc.nb)
     )
+
+
+def _check_same_source(*descs: Descriptor) -> None:
+    """Multi-matrix routines run all operands through one rolled grid, so
+    their descriptors must agree on the source rank (the reference likewise
+    requires operands on one CommunicatorGrid)."""
+    srcs = {(d.isrc, d.jsrc) for d in descs}
+    if len(srcs) > 1:
+        raise ValueError(
+            f"descriptors disagree on source rank (isrc, jsrc): {sorted(srcs)}; "
+            "all operands of one call must share it"
+        )
 
 
 def ppotrf(ctx: int, uplo: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
@@ -98,6 +120,7 @@ def ptrsm(
 ) -> np.ndarray:
     from dlaf_tpu.algorithms.triangular_solver import triangular_solver
 
+    _check_same_source(desc_a, desc_b)
     side_v = t.LEFT if side in ("L", t.LEFT) else t.RIGHT
     return triangular_solver(
         side_v, uplo, op, diag, alpha, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b)
@@ -109,6 +132,7 @@ def pgemm(
 ) -> np.ndarray:
     from dlaf_tpu.algorithms.multiplication import general_multiplication
 
+    _check_same_source(desc_a, desc_b, desc_c)
     return general_multiplication(
         opa, opb, alpha, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b), beta, _dist(ctx, c, desc_c)
     ).to_global()
@@ -137,6 +161,7 @@ def phegvd(
     """Generalized Hermitian eigensolver (dlaf_p*{sy,he}gvd[_factorized])."""
     from dlaf_tpu.algorithms.eigensolver import hermitian_generalized_eigensolver
 
+    _check_same_source(desc_a, desc_b)
     res = hermitian_generalized_eigensolver(
         uplo, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b),
         spectrum=spectrum, factorized=factorized,

@@ -59,6 +59,15 @@ class TuneParameters:
       band/sbr_band.  0 disables; -1 (default) = auto: 32 when the default
       JAX backend is an accelerator, off on CPU (measured: the CPU-mesh
       "device" stage costs more than the host chase it saves).
+    - ``band_chase_backend``: where the small-band -> tridiagonal bulge
+      chase runs: 'native' (threaded C++ host kernel), 'device' (batched
+      wavefront on the accelerator, algorithms/band_chase_device.py), or
+      'auto' (device when the default JAX backend is an accelerator, else
+      native — on CPU the "device" kernel shares the cores with the host
+      path and loses).
+    - ``band_chase_device_block``: sweeps per device-chase block (bounds
+      on-device reflector storage; each block stages its reflectors to
+      host on completion).
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -74,6 +83,12 @@ class TuneParameters:
     dc_leaf_size: int = field(default_factory=lambda: _env("dc_leaf_size", 512, int))
     eigensolver_matmul_precision: str = field(
         default_factory=lambda: _env("eigensolver_matmul_precision", "float32", str)
+    )
+    band_chase_backend: str = field(
+        default_factory=lambda: _env("band_chase_backend", "auto", str)
+    )
+    band_chase_device_block: int = field(
+        default_factory=lambda: _env("band_chase_device_block", 128, int)
     )
     cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
     trsm_lookahead: bool = field(default_factory=lambda: _env("trsm_lookahead", False, bool))

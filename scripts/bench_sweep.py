@@ -1,80 +1,114 @@
 #!/usr/bin/env python
-"""Strong/weak scaling sweep driver (reference: scripts/gen_dlaf_strong-gpu.py
-job generators + plot_*.py, compacted: one script that sweeps grid shapes /
-sizes on the available devices and emits a CSV for plot_scaling.py)."""
+"""Per-algorithm strong/weak scaling sweep driver.
+
+Reference analogue: the scripts/ suite of job generators + per-algorithm
+plotters (reference: scripts/gen_dlaf_strong-gpu.py, scripts/README.md:1-40
+— sbatch job trees over DLAF/SLATE/DPLASMA).  Single-controller equivalent:
+sweep {algorithm x grid shape x size} by driving the miniapp executables in
+SEQUENTIAL subprocesses (one JAX runtime at a time — concurrent XLA CPU
+compiles on a small host are unstable), parse their ``[i] name time GFlop/s``
+report lines, and emit one CSV consumed by plot_scaling.py.
+
+    python scripts/bench_sweep.py --algos cholesky,trsm,heev \
+        --grids 1x1,2x2,2x4 --sizes 2048,4096 --out sweep.csv
+    python scripts/plot_scaling.py sweep.csv         # per-algorithm plots
+
+``--algos all`` sweeps every miniapp.  On a CPU host set JAX_PLATFORMS=cpu
+and XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh.
+"""
 import argparse
 import csv
 import itertools
+import os
+import re
+import subprocess
 import sys
-import time
 
-import numpy as np
+# algorithm -> python -m module (+ leading positional for the suite driver)
+ALGOS = {
+    "cholesky": ["dlaf_tpu.miniapp.miniapp_cholesky"],
+    "trsm": ["dlaf_tpu.miniapp.miniapp_triangular_solver"],
+    "heev": ["dlaf_tpu.miniapp.miniapp_eigensolver"],
+    "hegv": ["dlaf_tpu.miniapp.miniapp_gen_eigensolver"],
+    **{
+        name: ["dlaf_tpu.miniapp.miniapp_suite", name]
+        for name in (
+            "trmm", "hemm", "gen_to_std", "red2band", "band2trid", "tridiag",
+            "trtri", "potri", "bt_red2band", "norm", "permute",
+        )
+    },
+}
+
+_LINE = re.compile(r"^\[\d+\] \S+ ([0-9.eE+-]+)s ([0-9.eE+-]+|nan)GFlop/s")
+
+
+def run_one(algo, n, pr, pc, mb, dtype, nruns, timeout):
+    mod = ALGOS[algo]
+    cmd = [
+        sys.executable, "-m", mod[0], *mod[1:],
+        "--m", str(n), "--mb", str(mb), "--type", dtype,
+        "--grid-rows", str(pr), "--grid-cols", str(pc), "--nruns", str(nruns),
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout)
+    times, gflops = [], []
+    for line in r.stdout.splitlines():
+        m = _LINE.match(line)
+        if m:
+            times.append(float(m.group(1)))
+            gflops.append(float(m.group(2)))
+    if not times:
+        return None, None, r
+    best = min(times)
+    gf = max(g for g in gflops) if gflops else float("nan")
+    return best, gf, r
 
 
 def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--algo", default="cholesky", choices=["cholesky", "trsm", "red2band"])
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--algos", default="cholesky",
+                   help=f"comma list or 'all'; known: {','.join(ALGOS)}")
     p.add_argument("--sizes", default="2048,4096,8192")
     p.add_argument("--mb", type=int, default=256)
     p.add_argument("--type", choices="sdcz", default="s")
     p.add_argument("--grids", default="1x1", help="comma list, e.g. 1x1,2x2,2x4")
+    p.add_argument("--nruns", type=int, default=3)
+    p.add_argument("--timeout", type=int, default=1800, help="per-config seconds")
     p.add_argument("--out", default="scaling.csv")
     args = p.parse_args()
 
-    import jax
-
-    import dlaf_tpu.testing as tu
-    from dlaf_tpu.comm.grid import Grid
-    from dlaf_tpu.common.index import Size2D
-    from dlaf_tpu.matrix.matrix import DistributedMatrix
-    from dlaf_tpu.miniapp.common import DTYPES, ops_add_mul, sync
-    from dlaf_tpu.ops import tile as t
-
-    dtype = DTYPES[args.type]
-    if np.dtype(dtype).itemsize == 8:
-        jax.config.update("jax_enable_x64", True)
+    algos = list(ALGOS) if args.algos == "all" else args.algos.split(",")
+    unknown = [a for a in algos if a not in ALGOS]
+    if unknown:
+        p.error(f"unknown algos {unknown}; known: {sorted(ALGOS)}")
     rows = []
-    for gs, n in itertools.product(args.grids.split(","), args.sizes.split(",")):
+    for algo, gs, n in itertools.product(
+        algos, args.grids.split(","), args.sizes.split(",")
+    ):
         pr, pc = (int(v) for v in gs.split("x"))
         n = int(n)
-        if pr * pc > len(jax.devices()):
+        try:
+            best, gf, r = run_one(algo, n, pr, pc, args.mb, args.type,
+                                  args.nruns, args.timeout)
+        except subprocess.TimeoutExpired:
+            print(f"{algo} n={n} grid={gs}: TIMEOUT after {args.timeout}s")
             continue
-        grid = Grid.create(Size2D(pr, pc))
-        a = tu.random_hermitian_pd(n, dtype, seed=1)
-        if args.algo == "cholesky":
-            from dlaf_tpu.algorithms.cholesky import cholesky_factorization as run_algo
-
-            run = lambda m: run_algo("L", m)
-            fl = ops_add_mul(dtype, n**3 / 6, n**3 / 6)
-        elif args.algo == "trsm":
-            from dlaf_tpu.algorithms.triangular_solver import triangular_solver
-
-            mat_a = DistributedMatrix.from_global(grid, np.tril(a) + n * np.eye(n, dtype=np.dtype(dtype)), (args.mb, args.mb))
-            run = lambda m: triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, m)
-            fl = ops_add_mul(dtype, n**3 / 2, n**3 / 2)
-        else:
-            from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
-
-            run = lambda m: reduction_to_band(m)[0]
-            fl = ops_add_mul(dtype, 2 * n**3 / 3, 2 * n**3 / 3)
-        best = None
-        for i in range(3):
-            mat = DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
-            sync(mat.data)
-            t0 = time.perf_counter()
-            out = run(mat)
-            sync(out.data)
-            dt = time.perf_counter() - t0
-            if i:
-                best = dt if best is None else min(best, dt)
-        gflops = fl / best / 1e9
-        print(f"{args.algo} n={n} grid={gs}: {best:.4f}s {gflops:.1f} GFlop/s")
-        rows.append({"algo": args.algo, "n": n, "grid": gs, "time_s": best, "gflops": gflops})
+        if best is None:
+            tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+            print(f"{algo} n={n} grid={gs}: FAILED rc={r.returncode}: {' | '.join(tail)}")
+            continue
+        print(f"{algo} n={n} grid={gs}: {best:.4f}s {gf:.1f} GFlop/s")
+        rows.append({
+            "algo": algo, "n": n, "grid": gs, "ranks": pr * pc,
+            "mb": args.mb, "dtype": args.type, "time_s": best, "gflops": gf,
+        })
+    if not rows:
+        print("no successful configs")
+        return 1
     with open(args.out, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
         w.writeheader()
         w.writerows(rows)
-    print(f"wrote {args.out}")
+    print(f"wrote {args.out} ({len(rows)} rows)")
     return 0
 
 

@@ -1,33 +1,92 @@
 #!/usr/bin/env python
-"""Plot scaling CSVs from bench_sweep.py (reference: scripts/plot_*.py).
-Falls back to an ASCII table when matplotlib is unavailable."""
+"""Per-algorithm scaling plots from a bench_sweep.py CSV.
+
+Reference analogue: scripts/plot_chol_strong.py, plot_evp_strong.py & co —
+one strong-scaling figure per algorithm (GFlop/s vs rank count, one line
+per matrix size) plus a size-scaling figure (GFlop/s vs N, one line per
+grid).  One command regenerates everything from the sweep CSV:
+
+    python scripts/plot_scaling.py sweep.csv [outdir]
+
+Falls back to ASCII tables when matplotlib is unavailable.
+"""
 import csv
+import os
 import sys
+from collections import defaultdict
 
 
-def main(path="scaling.csv"):
+def load(path):
     with open(path) as f:
         rows = list(csv.DictReader(f))
+    for r in rows:
+        r["n"] = int(r["n"])
+        r["gflops"] = float(r["gflops"])
+        r["time_s"] = float(r["time_s"])
+        r["ranks"] = int(r.get("ranks") or
+                        eval(r["grid"].replace("x", "*")))  # legacy CSVs
+    return rows
+
+
+def ascii_report(rows):
+    for r in rows:
+        print(f"{r['algo']:12s} n={r['n']:>7d} grid={r['grid']:>5s} "
+              f"{r['time_s']:9.4f}s {r['gflops']:10.1f} GF/s")
+
+
+def main(path="scaling.csv", outdir=None):
+    rows = load(path)
+    outdir = outdir or os.path.dirname(os.path.abspath(path))
     try:
         import matplotlib
 
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
-
-        fig, ax = plt.subplots()
-        for grid in sorted({r["grid"] for r in rows}):
-            pts = [(int(r["n"]), float(r["gflops"])) for r in rows if r["grid"] == grid]
-            ax.plot(*zip(*sorted(pts)), marker="o", label=grid)
-        ax.set_xlabel("N")
-        ax.set_ylabel("GFlop/s")
-        ax.set_xscale("log", base=2)
-        ax.legend(title="grid")
-        out = path.replace(".csv", ".png")
-        fig.savefig(out, dpi=150)
-        print(f"wrote {out}")
     except ImportError:
-        for r in rows:
-            print(f"{r['algo']:10s} n={r['n']:>7s} grid={r['grid']:>5s} {float(r['gflops']):10.1f} GF/s")
+        ascii_report(rows)
+        return
+    by_algo = defaultdict(list)
+    for r in rows:
+        by_algo[r["algo"]].append(r)
+    written = []
+    for algo, rs in sorted(by_algo.items()):
+        # strong scaling: GFlop/s vs ranks, one line per N
+        fig, ax = plt.subplots()
+        for n in sorted({r["n"] for r in rs}):
+            pts = sorted((r["ranks"], r["gflops"]) for r in rs if r["n"] == n)
+            if len(pts) > 1:
+                ax.plot(*zip(*pts), marker="o", label=f"N={n}")
+        if ax.lines:
+            ax.set_xlabel("devices")
+            ax.set_ylabel("GFlop/s")
+            ax.set_xscale("log", base=2)
+            ax.set_title(f"{algo} strong scaling")
+            ax.legend()
+            out = os.path.join(outdir, f"{algo}_strong.png")
+            fig.savefig(out, dpi=150)
+            written.append(out)
+        plt.close(fig)
+        # size scaling: GFlop/s vs N, one line per grid
+        fig, ax = plt.subplots()
+        for grid in sorted({r["grid"] for r in rs}):
+            pts = sorted((r["n"], r["gflops"]) for r in rs if r["grid"] == grid)
+            if len(pts) > 1:
+                ax.plot(*zip(*pts), marker="o", label=grid)
+        if ax.lines:
+            ax.set_xlabel("N")
+            ax.set_ylabel("GFlop/s")
+            ax.set_xscale("log", base=2)
+            ax.set_title(f"{algo} size scaling")
+            ax.legend(title="grid")
+            out = os.path.join(outdir, f"{algo}_size.png")
+            fig.savefig(out, dpi=150)
+            written.append(out)
+        plt.close(fig)
+    if written:
+        for w in written:
+            print(f"wrote {w}")
+    else:
+        ascii_report(rows)
 
 
 if __name__ == "__main__":

@@ -57,9 +57,8 @@ def test_sbr_reduce(n, b1, b2, dtype):
     ab = _to_compact(a, b1)
     ab2, tr = sbr_reduce(ab, b1, b2)
     red = _from_compact(ab2, n, b2)
-    # bandwidth achieved
     i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
-    assert ab2.shape[0] == b2 + 2 and np.abs(ab2[b2 + 1]).max() == 0
+    assert ab2.shape[0] == b2 + 2
     # eigenvalues preserved
     np.testing.assert_allclose(
         np.linalg.eigvalsh(red), np.linalg.eigvalsh(a), atol=1e-9 * max(1, np.abs(a).max())
@@ -81,9 +80,12 @@ def test_sbr_reduce(n, b1, b2, dtype):
     np.testing.assert_allclose(
         q.conj().T @ q, np.eye(n), atol=1e-10
     )
-    np.testing.assert_allclose(
-        q.conj().T @ a @ q, red, atol=1e-9 * max(1, np.abs(a).max())
-    )
+    qaq = q.conj().T @ a @ q
+    np.testing.assert_allclose(qaq, red, atol=1e-9 * max(1, np.abs(a).max()))
+    # bandwidth ACHIEVED (not just truncated storage): the independently
+    # rebuilt Q^H A Q must vanish beyond distance b2
+    beyond = np.abs(np.where(np.abs(i - j) > b2, qaq, 0)).max()
+    assert beyond < 1e-9 * max(1, np.abs(a).max())
 
 
 def test_sbr_f32():

@@ -83,6 +83,107 @@ def test_capi_inprocess_syevd(shim):
     lib.dlaf_free_grid(ctx)
 
 
+def test_capi_inprocess_trsm_gemm_trtri(shim):
+    """New breadth routines through the ctypes branch (f64)."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    for f in ("dlaf_pdtrsm", "dlaf_pdgemm", "dlaf_pdtrtri", "dlaf_pdpotri"):
+        getattr(lib, f).restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb, k = 12, 4, 8
+    rng = np.random.default_rng(3)
+    a = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    b = rng.standard_normal((n, k))
+    abuf, bbuf = np.asfortranarray(a), np.asfortranarray(b)
+    dp = ctypes.POINTER(ctypes.c_double)
+    rc = lib.dlaf_pdtrsm(
+        ctypes.c_char(b"L"), ctypes.c_char(b"L"), ctypes.c_char(b"N"),
+        ctypes.c_char(b"N"), ctypes.c_double(1.0),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        bbuf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(a @ bbuf, b, atol=1e-10)
+    cbuf = np.asfortranarray(np.zeros((n, k)))
+    rc = lib.dlaf_pdgemm(
+        ctypes.c_char(b"N"), ctypes.c_char(b"N"),
+        ctypes.c_double(1.0),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        bbuf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+        ctypes.c_double(0.0),
+        cbuf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(cbuf, a @ bbuf, atol=1e-10)
+    tbuf = np.asfortranarray(a)
+    rc = lib.dlaf_pdtrtri(
+        ctypes.c_char(b"L"), ctypes.c_char(b"N"),
+        tbuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(np.tril(tbuf), np.linalg.inv(a), atol=1e-8)
+    spd = _spd(n, np.float64, seed=4)
+    pbuf = np.asfortranarray(np.linalg.cholesky(spd))
+    rc = lib.dlaf_pdpotri(
+        ctypes.c_char(b"L"), pbuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb)
+    )
+    assert rc == 0
+    inv = np.tril(pbuf) + np.tril(pbuf, -1).T
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), atol=1e-8)
+    lib.dlaf_free_grid(ctx)
+
+
+def test_capi_inprocess_partial_spectrum(shim):
+    """dlaf_pdsyevd_partial_spectrum: 1-based inclusive [il, iu]
+    (reference eigensolver.h:121-127 eigenvalues_index_begin/end)."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pdsyevd_partial_spectrum.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb, il, iu = 16, 4, 3, 9
+    a = _spd(n, np.float64, seed=5)
+    abuf = np.asfortranarray(np.tril(a))
+    w = np.zeros(n, np.float64)
+    z = np.asfortranarray(np.zeros((n, n), np.float64))
+    dp = ctypes.POINTER(ctypes.c_double)
+    rc = lib.dlaf_pdsyevd_partial_spectrum(
+        ctypes.c_char(b"L"), abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        w.ctypes.data_as(dp), z.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        ctypes.c_long(il), ctypes.c_long(iu),
+    )
+    assert rc == 0
+    k = iu - il + 1
+    np.testing.assert_allclose(w[:k], np.linalg.eigvalsh(a)[il - 1 : iu], atol=1e-9)
+    zk = z[:, :k]
+    assert np.abs(a @ zk - zk * w[None, :k]).max() < 1e-8 * np.abs(a).max() * n
+    lib.dlaf_free_grid(ctx)
+
+
+def test_capi_inprocess_zheevd(shim):
+    """Complex double through the ctypes branch (w is real)."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pzheevd.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb = 12, 4
+    rng = np.random.default_rng(6)
+    h = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    a = h @ h.conj().T + n * np.eye(n)
+    abuf = np.asfortranarray(np.tril(a))
+    w = np.zeros(n, np.float64)
+    z = np.asfortranarray(np.zeros((n, n), np.complex128))
+    rc = lib.dlaf_pzheevd(
+        ctypes.c_char(b"L"), ctypes.c_void_p(abuf.ctypes.data),
+        _desc9(ctx, n, n, nb, nb),
+        ctypes.c_void_p(w.ctypes.data), ctypes.c_void_p(z.ctypes.data),
+        _desc9(ctx, n, n, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), atol=1e-9)
+    assert np.abs(a @ z - z * w[None, :]).max() < 1e-8 * np.abs(a).max() * n
+    lib.dlaf_free_grid(ctx)
+
+
 C_DRIVER = r"""
 #include <math.h>
 #include <stdio.h>
@@ -122,10 +223,63 @@ int main(void) {
       double e = fabs(acc - orig[i + j * n]);
       if (e > maxerr) maxerr = e;
     }
+  /* complex HEGV round-trip: A v = w B v with hermitian A, SPD B */
+  double complex *ca = malloc(n * n * sizeof(double complex));
+  double complex *cb = malloc(n * n * sizeof(double complex));
+  double complex *cz = malloc(n * n * sizeof(double complex));
+  double *w = malloc(n * sizeof(double));
+  double complex ch[144], cm[144];
+  for (int i = 0; i < n * n; ++i) {
+    s = s * 1103515245u + 12345u;
+    double re = ((double)(s >> 16) / 32768.0) - 1.0;
+    s = s * 1103515245u + 12345u;
+    double im = ((double)(s >> 16) / 32768.0) - 1.0;
+    ch[i] = re + im * I;
+    s = s * 1103515245u + 12345u;
+    re = ((double)(s >> 16) / 32768.0) - 1.0;
+    s = s * 1103515245u + 12345u;
+    im = ((double)(s >> 16) / 32768.0) - 1.0;
+    cm[i] = re + im * I;
+  }
+  for (int j = 0; j < n; ++j)
+    for (int i = 0; i < n; ++i) {
+      double complex accA = 0, accB = 0;
+      for (int k = 0; k < n; ++k) {
+        accA += ch[i + k * n] * conj(ch[j + k * n]);
+        accB += cm[i + k * n] * conj(cm[j + k * n]);
+      }
+      ca[i + j * n] = accA + (i == j ? n : 0);
+      cb[i + j * n] = accB + (i == j ? n : 0);
+      cz[i + j * n] = 0;
+    }
+  int ctx2 = dlaf_create_grid(2, 2);
+  int cdesc[9] = {1, ctx2, n, n, nb, nb, 0, 0, n};
+  /* keep full hermitian copies for the residual check before the call
+   * overwrites the triangles */
+  double complex *caf = malloc(n * n * sizeof(double complex));
+  double complex *cbf = malloc(n * n * sizeof(double complex));
+  for (int i = 0; i < n * n; ++i) { caf[i] = ca[i]; cbf[i] = cb[i]; }
+  rc = dlaf_pzhegvd('L', ca, cdesc, cb, cdesc, w, cz, cdesc);
+  if (rc != 0) { printf("HEGV FAIL %d\n", rc); return 1; }
+  double hegverr = 0;
+  for (int j = 0; j < n; ++j)
+    for (int i = 0; i < n; ++i) {
+      double complex av = 0, bv = 0;
+      for (int k = 0; k < n; ++k) {
+        av += caf[i + k * n] * cz[k + j * n];
+        bv += cbf[i + k * n] * cz[k + j * n];
+      }
+      double e = cabs(av - w[j] * bv);
+      if (e > hegverr) hegverr = e;
+    }
+  dlaf_free_grid(ctx2);
   dlaf_free_grid(ctx);
   dlaf_tpu_finalize();
-  if (maxerr < 1e-10) { printf("C CHECK PASSED (err=%g)\n", maxerr); return 0; }
-  printf("C CHECK FAILED (err=%g)\n", maxerr);
+  if (maxerr < 1e-10 && hegverr < 1e-8 * n) {
+    printf("C CHECK PASSED (err=%g hegv=%g)\n", maxerr, hegverr);
+    return 0;
+  }
+  printf("C CHECK FAILED (err=%g hegv=%g)\n", maxerr, hegverr);
   return 1;
 }
 """

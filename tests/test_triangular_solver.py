@@ -43,6 +43,27 @@ def test_trsm_combos(grid_2x4, side, uplo, op, diag):
     tu.assert_near(out, expected, tu.tol_for(dtype, an, 200.0))
 
 
+def test_trsm_lookahead_variant(comm_grids):
+    """Lookahead kernel matches the bucketed kernel on every grid (mirrors
+    test_cholesky_lookahead_variant; opt-in path must stay CI-covered)."""
+    from dlaf_tpu.tune import get_tune_parameters, initialize
+
+    m, n, mb = 21, 10, 4
+    a = tu.random_triangular(m, np.float64, lower=True, seed=7)
+    b = tu.random_matrix(m, n, np.float64, seed=8)
+    expected = sla.solve_triangular(a, b, lower=True)
+    initialize(trsm_lookahead=True)
+    try:
+        for grid in comm_grids[:4]:
+            mat_a = DistributedMatrix.from_global(grid, a, (mb, mb))
+            mat_b = DistributedMatrix.from_global(grid, b, (mb, mb))
+            out = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
+            tu.assert_near(out, expected, tu.tol_for(np.float64, m, 200.0))
+    finally:
+        initialize()
+    assert not get_tune_parameters().trsm_lookahead
+
+
 @pytest.mark.parametrize("dtype", tu.ELEMENT_TYPES, ids=str)
 def test_trsm_dtypes_all_grids(comm_grids, dtype):
     m, n, mb = 16, 12, 4
