@@ -69,7 +69,11 @@ def hermitian_eigensolver(
     nb = mat_a.block_size.rows
     n = mat_a.size.rows
     band = get_band_size(nb)
-    band_mat, taus = reduction_to_band(mat_a, band=band)
+    from dlaf_tpu.common import stagetimer as st
+
+    with st.stage("red2band"):
+        band_mat, taus = reduction_to_band(mat_a, band=band)
+        st.barrier(band_mat.data, taus)
     # default band stage: (optional) on-device SBR band shrink, then native
     # Householder bulge chasing (O(N^2 b_small) on host, compact reflector
     # set, no N x N Q2 anywhere) with the blocked compact-WY back-transform
@@ -81,19 +85,40 @@ def hermitian_eigensolver(
     # — no O(N^2) host object on this path.
     from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh_dist
 
-    hh, tr_sbr = _band_stage_hh(band_mat, band)
+    with st.stage("band_stage"):
+        hh, tr_sbr = _band_stage_hh(band_mat, band)
     if hh is not None:
-        evals, v = tridiagonal_eigensolver(
-            grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
-        )
-        e = bt_band_to_tridiagonal_hh_dist(hh, v)
+        with st.stage("tridiag"):
+            evals, v = tridiagonal_eigensolver(
+                grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
+            )
+            st.barrier(v.data)
+        with st.stage("bt_band"):
+            e = bt_band_to_tridiagonal_hh_dist(hh, v)
+            st.barrier(e.data)
         if tr_sbr is not None:
             from dlaf_tpu.algorithms.band_reduction import sbr_back_transform
 
-            e = sbr_back_transform(tr_sbr, e)
-        e = bt_reduction_to_band(e, band_mat, taus)
+            with st.stage("bt_sbr"):
+                e = sbr_back_transform(tr_sbr, e)
+                st.barrier(e.data)
+        with st.stage("bt_red2band"):
+            e = bt_reduction_to_band(e, band_mat, taus)
+            st.barrier(e.data)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage
+    if n > 0:  # m == 0 lands here too, but trivially — don't warn for it
+        import warnings
+
+        warnings.warn(
+            "band stage fallback: no bulge-chase backend (native C++ lib not "
+            "built and device wavefront kernel not selected) — using a DENSE "
+            "host Hessenberg band stage: O(N^2) host memory and O(N^3) host "
+            "flops instead of O(N^2 b). Build the native library (needs g++) "
+            "or set DLAF_TPU_BAND_CHASE_BACKEND=device.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     b2t = band_to_tridiagonal(band_mat, band=band)
     evals, e_tri = tridiagonal_eigensolver(
         grid, b2t.d, b2t.e, nb, dtype=mat_a.dtype, spectrum=spectrum
@@ -153,13 +178,19 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
     chase_ok = get_lib() is not None or resolve_chase_backend() == "device"
     if b2 and chase_ok:
         from dlaf_tpu.algorithms.band_reduction import sbr_reduce
+        from dlaf_tpu.common import stagetimer as st
 
-        ab = extract_band_storage(band_mat, band)
-        ab2, tr = sbr_reduce(ab, band, b2, want_q=want_q)
-        if want_q:
-            hh = band_to_tridiagonal_hh_storage(ab2, b2, dt)
-            return hh, (tr if hh is not None and tr.n_sweeps else None)
-        return band_to_tridiagonal_storage(ab2, b2, dt), None
+        # no explicit barriers here: sbr_reduce and the chase return HOST
+        # arrays (each stages its device blocks through device_get), so the
+        # stage clocks already include their device work
+        with st.stage("band_stage/sbr"):
+            ab = extract_band_storage(band_mat, band)
+            ab2, tr = sbr_reduce(ab, band, b2, want_q=want_q)
+        with st.stage("band_stage/chase"):
+            if want_q:
+                hh = band_to_tridiagonal_hh_storage(ab2, b2, dt)
+                return hh, (tr if hh is not None and tr.n_sweeps else None)
+            return band_to_tridiagonal_storage(ab2, b2, dt), None
     if want_q:
         return band_to_tridiagonal_hh(band_mat, band=band), None
     if chase_ok:
@@ -246,13 +277,21 @@ def hermitian_generalized_eigensolver(
     ``factorized=True`` means ``mat_b`` already holds the Cholesky factor
     (reference hermitian_generalized_eigensolver_factorized,
     gen_eigensolver.h:99)."""
-    fac = mat_b if factorized else cholesky_factorization(uplo, mat_b)
-    a_std = generalized_to_standard(uplo, mat_a, fac)
-    a_tri = mutil.extract_triangle(a_std, uplo)
+    from dlaf_tpu.common import stagetimer as st
+
+    with st.stage("cholesky_b"):
+        fac = mat_b if factorized else cholesky_factorization(uplo, mat_b)
+        st.barrier(fac.data)
+    with st.stage("gen_to_std"):
+        a_std = generalized_to_standard(uplo, mat_a, fac)
+        a_tri = mutil.extract_triangle(a_std, uplo)
+        st.barrier(a_tri.data)
     res = hermitian_eigensolver(uplo, a_tri, spectrum=spectrum)
     # back-substitute: x = L^-H y (uplo=L) / U^-1 y (uplo=U)
-    if uplo == t.LOWER:
-        e = triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
-    else:
-        e = triangular_solver(t.LEFT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
+    with st.stage("back_subst"):
+        if uplo == t.LOWER:
+            e = triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
+        else:
+            e = triangular_solver(t.LEFT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
+        st.barrier(e.data)
     return EigResult(res.eigenvalues, e)

@@ -2,10 +2,17 @@
 
 TPU-native analogue of the reference HDF5 matrix I/O
 (reference: include/dlaf/matrix/hdf5.h:94-308 FileHDF5 — per-rank hyperslab
-read/write, used by debug dumps and miniapp --input-file).  HDF5 isn't in
-this image; .npz carries the same payload (global array + distribution
-metadata).  Large-matrix sharded output writes one file per grid rank
-(the hyperslab analogue).
+read/write, used by debug dumps and miniapp --input-file).  Three formats:
+
+- ``.h5`` (h5py): the reference's own format — one dataset per matrix.
+  The WRITE path streams tile-row slabs (<= mb x N host staging, the
+  single-controller hyperslab analogue); the read path materializes the
+  global array on the controller host before scattering to the mesh (one
+  N^2 host buffer — the reference reads N^2/P per rank).
+- ``.npz``: global array + distribution metadata in one file.
+- sharded ``.npy``: one file per grid rank holding its local tile stack.
+
+``save``/``load`` pick by extension.
 """
 from __future__ import annotations
 
@@ -28,7 +35,9 @@ def maybe_dump(flag_name: str, path: str, mat: DistributedMatrix) -> None:
 
 
 def save(path: str, mat: DistributedMatrix) -> None:
-    """Save a matrix (gathered) + metadata to one .npz."""
+    """Save a matrix + metadata; format by extension (.h5 -> HDF5)."""
+    if str(path).endswith((".h5", ".hdf5")):
+        return save_hdf5(path, mat)
     np.savez_compressed(
         path,
         data=mat.to_global(),
@@ -38,10 +47,72 @@ def save(path: str, mat: DistributedMatrix) -> None:
 
 
 def load(path: str, grid: Grid, block_size=None) -> DistributedMatrix:
+    if str(path).endswith((".h5", ".hdf5")):
+        return load_hdf5(path, grid, block_size=block_size)
     with np.load(path) as z:
         a = z["data"]
         bs = tuple(z["block_size"]) if block_size is None else tuple(block_size)
     return DistributedMatrix.from_global(grid, a, Size2D(*bs))
+
+
+def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
+    """Write to an HDF5 dataset ``name`` of global shape (reference
+    FileHDF5::write, matrix/hdf5.h:94-308).  Streams one tile-row slab at a
+    time — a single device fetch of that row's tile stack per slab, <= mb x N
+    host staging, never the full N^2; block/grid geometry is attached as
+    dataset attributes so a read can reproduce the distribution."""
+    import h5py
+
+    m, n = mat.size
+    mb, nb = mat.block_size
+    pr, pc = mat.dist.grid_size
+    sr, sc = mat.dist.source_rank
+    with h5py.File(path, "w") as f:
+        ds = f.create_dataset(name, shape=(m, n), dtype=np.dtype(mat.dtype))
+        ds.attrs["block_size"] = tuple(mat.block_size)
+        ds.attrs["grid_size"] = tuple(mat.dist.grid_size)
+        ds.attrs["source_rank"] = (sr, sc)
+        for i in range(mat.nr_tiles.rows):
+            r0 = i * mb
+            rows = min(mb, m - r0)
+            # ONE device round-trip per tile row: the whole [Pc, ltc, mb, nb]
+            # stack of owner row (i%pr + sr) % pr at slot i//pr
+            row_stack = np.asarray(mat.data[(i % pr + sr) % pr, :, i // pr])
+            slab = np.empty((rows, n), dtype=np.dtype(mat.dtype))
+            for j in range(mat.nr_tiles.cols):
+                c0 = j * nb
+                cols = min(nb, n - c0)
+                t = row_stack[(j % pc + sc) % pc, j // pc]
+                slab[:, c0 : c0 + cols] = t[:rows, :cols]
+            ds[r0 : r0 + rows] = slab
+
+
+def load_hdf5(
+    path: str, grid: Grid, name: str = "a", block_size=None
+) -> DistributedMatrix:
+    """Read an HDF5 dataset into a DistributedMatrix (reference
+    FileHDF5::read).  ``block_size=None`` takes the stored attribute
+    (falling back to tune's default_block_size for foreign files).
+    Materializes the global array on the controller host (one N^2 buffer)
+    before scattering to the mesh."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        ds = f[name]
+        if block_size is None:
+            if "block_size" in ds.attrs:
+                block_size = tuple(int(v) for v in ds.attrs["block_size"])
+            else:
+                from dlaf_tpu.tune import get_tune_parameters
+
+                b = int(get_tune_parameters().default_block_size)
+                block_size = (b, b)
+        src = tuple(int(v) for v in ds.attrs.get("source_rank", (0, 0)))
+        a = ds[()]
+    # source_rank only reproducible on a matching grid shape
+    pr, pc = grid.grid_size
+    src = (src[0] % pr, src[1] % pc)
+    return DistributedMatrix.from_global(grid, a, Size2D(*block_size), source_rank=src)
 
 
 def save_sharded(prefix: str, mat: DistributedMatrix) -> None:

@@ -175,6 +175,12 @@ def window_extract(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
     DistributedMatrix — any element origin, O(window) device work."""
     r0, c0 = (int(v) for v in origin)
     m, n = (int(v) for v in size)
+    if tuple(mat.dist.source_rank) != (0, 0):
+        raise NotImplementedError(
+            "window_extract: nonzero source_rank (the rank-shift algebra "
+            "assumes tile (0,0) on rank (0,0)); use matrix.util.sub_matrix, "
+            "which falls back to the layout-based path"
+        )
     if (
         r0 < 0 or c0 < 0
         or r0 + m > mat.size.rows or c0 + n > mat.size.cols
@@ -203,6 +209,16 @@ def window_update(mat: DistributedMatrix, origin, win: DistributedMatrix) -> Dis
     Returns the updated parent (functional in-place)."""
     r0, c0 = (int(v) for v in origin)
     m, n = win.size
+    if tuple(mat.dist.source_rank) != (0, 0) or tuple(win.dist.source_rank) != (0, 0):
+        raise NotImplementedError(
+            "window_update: nonzero source_rank (the rank-shift algebra "
+            "assumes tile (0,0) on rank (0,0))"
+        )
+    if win.grid.cache_key != mat.grid.cache_key:
+        raise ValueError(
+            "window_update: win and mat must live on the same mesh (got "
+            "different grids — data would combine across device orders)"
+        )
     if (
         r0 < 0 or c0 < 0
         or r0 + m > mat.size.rows or c0 + n > mat.size.cols

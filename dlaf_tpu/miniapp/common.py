@@ -87,6 +87,12 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "with TensorBoard / xprof; the per-stage analogue of the reference's "
         "pika/APEX instrumentation hooks — SURVEY §5 tracing row)",
     )
+    p.add_argument(
+        "--stage-times", action="store_true",
+        help="print a per-stage wall-time breakdown after each timed run "
+        "(syncs at stage boundaries — slightly serializes async dispatch); "
+        "instrumented pipelines: eigensolver / gen_eigensolver",
+    )
     return p
 
 
@@ -101,6 +107,9 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
     the first timed run is captured by the JAX profiler (host + device
     timelines; XLA op breakdown per pipeline stage)."""
     trace_dir = getattr(args, "trace", "")
+    stage_times = getattr(args, "stage_times", False)
+    if stage_times:
+        from dlaf_tpu.common import stagetimer
     results = []
     for i in range(-args.nwarmups, args.nruns):
         mat = make_input()
@@ -108,10 +117,19 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
         tracing = trace_dir and i == 0
         if tracing:
             jax.profiler.start_trace(trace_dir)
+        if stage_times and i >= 0:
+            stagetimer.start()
         t0 = time.perf_counter()
         out = run(mat)
         sync(out.data)
         dt = time.perf_counter() - t0
+        if stage_times and i >= 0:
+            br = stagetimer.stop()
+            if br:
+                print(f"[{i}] stages: {stagetimer.report(br, dt)}")
+            else:
+                print(f"[{i}] stages: none recorded (this driver's "
+                      "algorithm has no stage instrumentation)")
         if tracing:
             jax.profiler.stop_trace()
             print(f"[0] trace written to {trace_dir}")

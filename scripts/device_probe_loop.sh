@@ -1,0 +1,17 @@
+#!/bin/sh
+# Probe the TPU tunnel every ~5 min; append one line per attempt to the log.
+# Used during build rounds to catch a liveness window for benchmarking.
+LOG="${1:-/tmp/device_probe.log}"
+while true; do
+  TS=$(date -u +%H:%M:%S)
+  OUT=$(timeout 50 python -c "
+import jax, jax.numpy as jnp, numpy as np
+x = jnp.ones((256, 256), np.float32)
+print('ALIVE', float(jnp.sum(x @ x)), jax.devices()[0].platform)
+" 2>&1 | tail -1)
+  case "$OUT" in
+    ALIVE*) echo "$TS $OUT" >> "$LOG" ;;
+    *) echo "$TS dead: $(echo "$OUT" | cut -c1-80)" >> "$LOG" ;;
+  esac
+  sleep 280
+done

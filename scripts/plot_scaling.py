@@ -23,8 +23,11 @@ def load(path):
         r["n"] = int(r["n"])
         r["gflops"] = float(r["gflops"])
         r["time_s"] = float(r["time_s"])
-        r["ranks"] = int(r.get("ranks") or
-                        eval(r["grid"].replace("x", "*")))  # legacy CSVs
+        if r.get("ranks"):
+            r["ranks"] = int(r["ranks"])
+        else:  # legacy CSVs: derive from the "PRxPC" grid field
+            pr, pc = r["grid"].split("x")
+            r["ranks"] = int(pr) * int(pc)
     return rows
 
 

@@ -105,3 +105,32 @@ def test_partial_spectrum_windowed_slice(grid_2x4):
     assert vg.shape == (n, iu - il + 1)
     resid = np.abs(tfull @ vg - vg * w[None, :]).max()
     assert resid < 1e-10 * max(1.0, np.abs(wref).max()) * n
+
+
+def test_sub_matrix_nonzero_source_rank(grid_2x4):
+    """sub_matrix on a matrix with nonzero source_rank must NOT take the
+    window realignment (its rank-shift algebra assumes source (0,0) —
+    advisor r3 medium finding): the layout fallback handles source ranks."""
+    from dlaf_tpu.matrix.util import sub_matrix
+
+    a = tu.random_matrix(24, 24, np.float64, seed=9)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (8, 8), source_rank=(1, 2))
+    got = sub_matrix(mat, (3, 5), (13, 11)).to_global()
+    np.testing.assert_array_equal(got, a[3:16, 5:16])
+    # and the window functions reject it loudly rather than mis-shifting
+    with pytest.raises(NotImplementedError):
+        window_extract(mat, (3, 5), (13, 11))
+    win = DistributedMatrix.from_global(grid_2x4, a[:8, :8], (8, 8))
+    with pytest.raises(NotImplementedError):
+        window_update(mat, (0, 0), win)
+
+
+def test_window_update_grid_mismatch(comm_grids):
+    """window_update across two different grids would silently combine data
+    across device orders (advisor r3 low finding) — must raise."""
+    g1, g2 = comm_grids[0], comm_grids[1]
+    a = tu.random_matrix(16, 16, np.float64, seed=10)
+    mat = DistributedMatrix.from_global(g1, a, (8, 8))
+    win = DistributedMatrix.from_global(g2, a[:8, :8], (8, 8))
+    with pytest.raises(ValueError, match="grid"):
+        window_update(mat, (0, 0), win)
