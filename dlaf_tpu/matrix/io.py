@@ -55,6 +55,19 @@ def load(path: str, grid: Grid, block_size=None) -> DistributedMatrix:
     return DistributedMatrix.from_global(grid, a, Size2D(*bs))
 
 
+def load_global(path: str, name: str = "a") -> np.ndarray:
+    """Read just the HOST global array from a matrix file — the one place
+    that knows the format contract (.h5/.hdf5 dataset ``name``; .npz key
+    'data'); used by miniapp ``--input-file``."""
+    if str(path).endswith((".h5", ".hdf5")):
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            return f[name][()]
+    with np.load(path) as z:
+        return z["data"]
+
+
 def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
     """Write to an HDF5 dataset ``name`` of global shape (reference
     FileHDF5::write, matrix/hdf5.h:94-308).  Streams one tile-row slab at a

@@ -17,9 +17,11 @@ import numpy as np
 
 # persistent XLA compilation cache: repeated miniapp/bench invocations skip
 # recompiles (the reference has no analogue; compiles are XLA's one-time cost).
-# Partitioned by (platform, forced host device count): deserializing an
-# executable cached under a different device topology can SEGFAULT inside
-# backend.deserialize_executable, so configurations must never share a dir.
+# Partitioned by (platform, forced host device count, host CPU fingerprint):
+# deserializing an executable cached under a different device topology can
+# SEGFAULT inside backend.deserialize_executable, and an XLA:CPU AOT blob
+# compiled on a host with different ISA features loads with a SIGILL warning
+# — configurations/machines must never share a dir.
 # DLAF_TPU_COMPILE_CACHE="" disables the persistent cache entirely.
 import re as _re
 
@@ -31,7 +33,31 @@ if _cache_base:
     _m = _re.search(
         r"host_platform_device_count=(\d+)", os.environ.get("XLA_FLAGS", "")
     )
-    _cache_dir = os.path.join(_cache_base, f"{_plat}-{_m.group(1) if _m else 1}")
+
+    def _host_fingerprint() -> str:
+        """Short hash of the host's CPU feature flags (ISA compatibility).
+        x86 cpuinfo says 'flags', aarch64 says 'Features'; if neither
+        appears, hash the whole cpuinfo rather than degrade to a constant."""
+        import hashlib
+
+        try:
+            with open("/proc/cpuinfo") as f:
+                txt = f.read()
+            for line in txt.splitlines():
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+            return hashlib.sha1(txt.encode()).hexdigest()[:8]
+        except OSError:
+            import platform
+
+            return hashlib.sha1(
+                f"{platform.machine()}-{platform.processor()}".encode()
+            ).hexdigest()[:8]
+
+    _cache_dir = os.path.join(
+        _cache_base,
+        f"{_plat}-{_m.group(1) if _m else 1}-{_host_fingerprint()}",
+    )
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -88,12 +114,52 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "pika/APEX instrumentation hooks — SURVEY §5 tracing row)",
     )
     p.add_argument(
+        "--input-file", default="", metavar="FILE",
+        help="read the input matrix from FILE (.h5 dataset 'a', or .npz) "
+        "instead of generating one; the matrix size overrides --m "
+        "(reference MiniappOptions --input-file; supported by the "
+        "cholesky and eigensolver drivers)",
+    )
+    p.add_argument(
+        "--output-file", default="", metavar="FILE",
+        help="save the final timed run's output matrix to FILE "
+        "(.h5/.npz via matrix.io)",
+    )
+    p.add_argument(
         "--stage-times", action="store_true",
         help="print a per-stage wall-time breakdown after each timed run "
         "(syncs at stage boundaries — slightly serializes async dispatch); "
         "instrumented pipelines: eigensolver / gen_eigensolver",
     )
     return p
+
+
+def host_input(args, dtype, gen):
+    """The driver's input matrix: ``--input-file`` (h5/npz, via
+    matrix.io.load_global) when given — its size overrides ``--m``, like
+    the reference's miniapp input files — else the generated matrix from
+    ``gen()``."""
+    path = getattr(args, "input_file", "")
+    if not path:
+        return gen()
+    from dlaf_tpu.matrix.io import load_global
+
+    a = load_global(path)
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(f"--input-file matrix must be square, got {a.shape}")
+    args.m = int(a.shape[0])
+    return np.asarray(a, dtype=dtype)
+
+
+def reject_input_file(args, driver: str) -> None:
+    """Fail loudly in drivers whose input is not a single matrix — silently
+    benchmarking a generated matrix while the user passed --input-file
+    would report numbers for the wrong input."""
+    if getattr(args, "input_file", ""):
+        raise SystemExit(
+            f"--input-file is not supported by the {driver} driver "
+            "(its input is not a single square matrix)"
+        )
 
 
 def make_grid(args) -> Grid:
@@ -142,4 +208,9 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
         if check and (args.check == "all" or (args.check == "last" and i == args.nruns - 1)):
             check(out)
             print(f"[{i}] check passed")
+        if getattr(args, "output_file", "") and i == args.nruns - 1:
+            from dlaf_tpu.matrix import io as mio
+
+            mio.save(args.output_file, out)
+            print(f"[{i}] output written to {args.output_file}")
     return results

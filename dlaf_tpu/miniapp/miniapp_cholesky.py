@@ -22,7 +22,7 @@ def main(argv=None):
     args = common.miniapp_parser(__doc__).parse_args(argv)
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
-    a = tu.random_hermitian_pd(args.m, dtype, seed=1)
+    a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
 
     def make_input():
         return DistributedMatrix.from_global(grid, a, (args.mb, args.mb))

@@ -24,7 +24,8 @@ def main(argv=None):
     args = common.miniapp_parser(__doc__).parse_args(argv)
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
-    a = tu.random_hermitian_pd(args.m, dtype, seed=1)
+    # --input-file supplies A; B stays generated (SPD, seeded)
+    a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
     b = tu.random_hermitian_pd(args.m, dtype, seed=2)
     mat_b_src = np.tril(b)
 

@@ -31,6 +31,7 @@ def main(argv=None):
     name = argv.pop(0)
     p = common.miniapp_parser(__doc__)
     args = p.parse_args(argv)
+    common.reject_input_file(args, name)
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
     m, mb = args.m, args.mb

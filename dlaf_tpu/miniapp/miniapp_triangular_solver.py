@@ -24,6 +24,7 @@ def main(argv=None):
     p = common.miniapp_parser(__doc__)
     p.add_argument("--n", type=int, default=None)
     args = p.parse_args(argv)
+    common.reject_input_file(args, "triangular_solver")
     if args.n is None:
         args.n = args.m
     grid = common.make_grid(args)

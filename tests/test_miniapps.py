@@ -49,3 +49,25 @@ def test_kernel_runner():
     from dlaf_tpu.miniapp import kernel_runner
 
     assert kernel_runner.main(["--nb", "16", "--batch", "2", "--nreps", "1"]) == 0
+
+
+def test_miniapp_input_output_file(tmp_path):
+    """--input-file / --output-file (reference MiniappOptions input files):
+    the input's size overrides --m; the factor round-trips through HDF5."""
+    import numpy as np
+
+    h5py = pytest.importorskip("h5py")
+    import dlaf_tpu.testing as tu
+
+    a = tu.random_hermitian_pd(40, np.float64, seed=7)
+    pin = str(tmp_path / "in.h5")
+    pout = str(tmp_path / "out.h5")
+    with h5py.File(pin, "w") as f:
+        f.create_dataset("a", data=a)
+    res = miniapp_cholesky.main(
+        ARGS + ["--check", "last", "--input-file", pin, "--output-file", pout]
+    )
+    assert len(res) == 1
+    with h5py.File(pout, "r") as f:
+        lout = np.tril(f["a"][()])
+    np.testing.assert_allclose(lout, np.linalg.cholesky(a), atol=1e-10)
