@@ -1,0 +1,94 @@
+"""Multi-host (multi-process) runtime bring-up.
+
+TPU-native analogue of the reference's MPI world initialization
+(reference: include/dlaf/communication/init.h MPI init guard +
+src/init.cpp:366-443 — MPI_THREAD_MULTIPLE check, pika MPI polling).  On
+TPU pods the communication backend is XLA collectives over ICI/DCN; the
+only host-side obligation is bringing up the JAX distributed runtime so
+``jax.devices()`` spans every process's chips.  After :func:`initialize`,
+the normal single-controller-style code runs unchanged on every process
+(classic SPMD — the same obligation the reference places on its MPI
+ranks): build one :class:`~dlaf_tpu.comm.grid.Grid` over the global
+device list, initialize matrices with
+``DistributedMatrix.from_global``/``from_element_function`` (every
+process passes the same global content), call algorithms.
+
+Environment-driven (the standard JAX cluster envs / TPU metadata), or
+explicit::
+
+    from dlaf_tpu.comm import multihost
+    multihost.initialize()                       # TPU pod / cluster envs
+    multihost.initialize("host0:1234", 4, rank)  # explicit coordinator
+
+This module is exercised in CI only in its single-process form (this
+container has one process); the multi-process branches use the standard
+``jax.distributed`` / ``make_array_from_callback`` / replicate-gather
+APIs and carry no environment-specific logic.
+"""
+from __future__ import annotations
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the JAX distributed runtime (idempotent).
+
+    With no arguments, defers to ``jax.distributed.initialize()``'s
+    environment/cloud autodetection (TPU pod metadata, SLURM, etc.).  A
+    single-process environment where autodetection finds no cluster is
+    left untouched — algorithms run exactly as before.  A later EXPLICIT
+    call (with a coordinator address) overrides an earlier no-op.
+    """
+    global _initialized
+    explicit = coordinator_address is not None
+    if _initialized and not explicit:
+        return  # an explicit call may still override an earlier no-op
+
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except ValueError:
+        # jax's cluster autodetection (TPU pod metadata, SLURM, GKE, the
+        # coordinator envs) found nothing and no explicit coordinator was
+        # given: a single-process world, nothing to bring up
+        if explicit:
+            raise
+    except RuntimeError:
+        # backend already initialized / double init: fine when the world is
+        # effectively single-process; otherwise the caller initialized too
+        # late (after first device use) and must hear about it
+        if not explicit and jax.process_count() == 1:
+            import warnings
+
+            warnings.warn(
+                "multihost.initialize() called after the XLA backend came "
+                "up; continuing single-process",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        else:
+            raise
+    _initialized = True
+
+
+def process_info() -> tuple[int, int]:
+    """(process_id, process_count) of the running world."""
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def is_main_process() -> bool:
+    """True on the process that should do controller-side printing/IO."""
+    import jax
+
+    return jax.process_index() == 0

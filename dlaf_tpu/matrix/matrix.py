@@ -28,6 +28,20 @@ from dlaf_tpu.matrix import layout
 from dlaf_tpu.matrix.distribution import Distribution
 
 
+_replicate_cache: dict = {}
+
+
+def _replicate_fn(grid: Grid):
+    """Cached jitted identity with fully-replicated output sharding (one
+    compile per mesh, not per to_global call)."""
+    key = grid.cache_key
+    if key not in _replicate_cache:
+        _replicate_cache[key] = jax.jit(
+            lambda v: v, out_shardings=grid.replicated_sharding()
+        )
+    return _replicate_cache[key]
+
+
 class DistributedMatrix:
     """A dense ``m x n`` matrix, 2D block-cyclic over ``grid``.
 
@@ -86,13 +100,22 @@ class DistributedMatrix:
     def from_global(
         cls, grid: Grid, a, block_size, source_rank=(0, 0)
     ) -> "DistributedMatrix":
-        """Distribute a host/global (m, n) array (pads, packs, places)."""
+        """Distribute a host/global (m, n) array (pads, packs, places).
+
+        Multi-host: every process must pass the SAME global array (the
+        reference's per-rank element initialization makes the same
+        assumption); each process then places only its addressable shards
+        (``jax.make_array_from_callback``)."""
         a = np.asarray(a)
         dist = Distribution(
             Size2D(*a.shape), Size2D(*block_size), grid.grid_size, Index2D(*source_rank)
         )
         x = layout.pack(layout.pad_global(a, dist), dist)
-        data = jax.device_put(jnp.asarray(x), grid.stacked_sharding())
+        sharding = grid.stacked_sharding()
+        if jax.process_count() > 1:
+            data = jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+        else:
+            data = jax.device_put(jnp.asarray(x), sharding)
         return cls(dist, grid, data)
 
     @classmethod
@@ -126,8 +149,16 @@ class DistributedMatrix:
 
     # --- host-side access (tests / IO) ---------------------------------------
     def to_global(self) -> np.ndarray:
-        """Gather the full matrix to host (reference: test util ``gather``)."""
-        x = np.asarray(jax.device_get(self.data))
+        """Gather the full matrix to host (reference: test util ``gather``).
+
+        Multi-host: the stacked array is first replicated across processes
+        (an all-gather over ICI/DCN inside jit), then read from local
+        shards — every process returns the full matrix."""
+        if jax.process_count() > 1:
+            gathered = _replicate_fn(self.grid)(self.data)
+            x = np.asarray(gathered.addressable_data(0))
+        else:
+            x = np.asarray(jax.device_get(self.data))
         return np.asarray(layout.unpad_global(layout.unpack(x, self.dist), self.dist))
 
     def get_tile(self, gt) -> np.ndarray:

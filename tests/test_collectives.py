@@ -93,3 +93,24 @@ def test_transpose_panel(comm_grids, shape):
                 j = lj * pc + c
                 want = (j + 1.0) if j < mt else 0.0
                 np.testing.assert_array_equal(out[r, c, lj], np.full((mb, mb), want))
+
+
+def test_multihost_single_process_noop(grid_2x4):
+    """multihost.initialize is a no-op in a single-process world and the
+    data paths still round-trip (the multi-process branches use the same
+    standard APIs; reference analogue: MPI init guard,
+    communication/init.h)."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.comm import multihost
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    multihost.initialize()
+    multihost.initialize()  # idempotent
+    pid, pcount = multihost.process_info()
+    assert (pid, pcount) == (0, 1)
+    assert multihost.is_main_process()
+    a = tu.random_matrix(24, 24, np.float64, seed=11)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (8, 8))
+    np.testing.assert_array_equal(mat.to_global(), a)
