@@ -243,22 +243,32 @@ _bt_cache = {}
 
 
 def sbr_back_transform(tr: SbrTransforms, mat_e):
-    """E := Q_sbr E with E distributed (stacked block-cyclic): reshard to
-    column panels (one all-to-all), stream the host-staged Q chunks through
-    the device in reverse, apply each sweep's batched blocks locally, and
-    reshard back — the same communication-free-rows pattern as bt_band_hh
-    (reference: bt_band_to_tridiag/impl.h distributed path)."""
+    """E := Q_sbr E with E distributed: reshard to column panels (one
+    all-to-all), stream the host-staged Q chunks through the device in
+    reverse, apply each sweep's batched blocks locally, and reshard back —
+    the same communication-free-rows pattern as bt_band_hh
+    (reference: bt_band_to_tridiag/impl.h distributed path).
+
+    ``mat_e`` may be a stacked DistributedMatrix OR the column-sharded
+    :class:`~dlaf_tpu.matrix.colpanels.ColPanels` handed over by
+    ``bt_band_to_tridiagonal_hh_dist(..., out_cols=True)`` — the fused
+    form skips one unpack+pack all-to-all pair between the two stages."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+    from dlaf_tpu.matrix import colpanels as cpan
     from dlaf_tpu.matrix import layout
     from dlaf_tpu.tune import get_tune_parameters
 
+    in_cols = isinstance(mat_e, cpan.ColPanels)
     if tr.n_sweeps == 0:
-        return mat_e
-    n, k = mat_e.dist.size
+        return cpan.pack_to_matrix(mat_e) if in_cols else mat_e
+    if in_cols:
+        n, k = mat_e.n, mat_e.k
+    else:
+        n, k = mat_e.dist.size
     if n != tr.n:
         raise ValueError(f"sbr_back_transform: E rows {n} != transform n {tr.n}")
     b1, b2 = tr.b1, tr.b2
@@ -273,7 +283,7 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
     )
     grid = mat_e.grid
     dist = mat_e.dist
-    dt = np.dtype(mat_e.dtype)
+    dt = np.dtype(mat_e.data.dtype) if in_cols else np.dtype(mat_e.dtype)
     Ptot = grid.grid_size.count()
     kloc = -(-k // Ptot)
     kpad = kloc * Ptot
@@ -281,17 +291,38 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
     colspec = P(None, (ROW_AXIS, COL_AXIS))
     col_sh = NamedSharding(mesh, colspec)
     prec = get_tune_parameters().eigensolver_matmul_precision
-    pre_key = ("pre", grid.cache_key, dist, n_pad, kpad, dt)
-    if pre_key not in _bt_cache:
+    if in_cols:
+        # already column-sharded; only the row padding may differ (the WY
+        # stage pads to its window, we pad to the chase span).  Row pad is
+        # shard-local under column sharding — no communication.
+        e_cols = mat_e.data
+        if e_cols.shape[1] != kpad:
+            raise ValueError(
+                f"ColPanels kpad {e_cols.shape[1]} != expected {kpad}"
+            )
+        if e_cols.shape[0] < n_pad:
+            rp_key = ("rowpad", grid.cache_key, tuple(e_cols.shape), n_pad, dt)
+            if rp_key not in _bt_cache:
+                _bt_cache[rp_key] = jax.jit(
+                    lambda gp: jnp.pad(gp, ((0, n_pad - gp.shape[0]), (0, 0))),
+                    out_shardings=col_sh,
+                )
+            e_cols = _bt_cache[rp_key](e_cols)
+        else:
+            n_pad = int(e_cols.shape[0])
+    else:
+        pre_key = ("pre", grid.cache_key, dist, n_pad, kpad, dt)
+        if pre_key not in _bt_cache:
 
-        def pre(x):
-            gg = layout.unpad_global(layout.unpack(x, dist), dist)
-            gp = jnp.pad(gg, ((0, n_pad - n), (0, kpad - k)))
-            return jax.lax.with_sharding_constraint(gp, col_sh)
+            def pre(x):
+                gg = layout.unpad_global(layout.unpack(x, dist), dist)
+                gp = jnp.pad(gg, ((0, n_pad - n), (0, kpad - k)))
+                return jax.lax.with_sharding_constraint(gp, col_sh)
 
-        # no donation: the stacked input cannot alias the col-sharded
-        # padded output (different shapes), donating only warns
-        _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
+            # no donation: the stacked input cannot alias the col-sharded
+            # padded output (different shapes), donating only warns
+            _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
+        e_cols = _bt_cache[pre_key](mat_e.data)
     post_key = ("post", grid.cache_key, dist, n_pad, kpad, dt)
     if post_key not in _bt_cache:
 
@@ -299,7 +330,6 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
             return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
 
         _bt_cache[post_key] = jax.jit(post, out_shardings=grid.stacked_sharding())
-    e_cols = _bt_cache[pre_key](mat_e.data)
     with jax.default_matmul_precision(prec):
         for (s0, q) in reversed(tr.chunks):
             CH = q.shape[0]
@@ -319,4 +349,8 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
                 )
             e_cols = _bt_cache[akey](e_cols, jnp.asarray(q), jnp.asarray(s0))
     data = _bt_cache[post_key](e_cols)
+    if in_cols:
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+        return DistributedMatrix(dist, grid, data)
     return mat_e._inplace(data)

@@ -141,8 +141,9 @@ _dist_cache = {}
 
 
 def bt_band_to_tridiagonal_hh_dist(
-    hh, mat_e: DistributedMatrix, group_size: int | None = None
-) -> DistributedMatrix:
+    hh, mat_e: DistributedMatrix, group_size: int | None = None,
+    out_cols: bool = False,
+):
     """E := Q2 E with E ALREADY DISTRIBUTED (block-cyclic stacked layout).
 
     The rotations act on E's rows and E's columns are independent, so the
@@ -153,7 +154,13 @@ def bt_band_to_tridiagonal_hh_dist(
     (second all-to-all).  This replaces the reference's p2p exchange of E
     rows (bt_band_to_tridiag/impl.h distributed path) with two cheap
     relayouts — the TPU-native choice, since XLA owns layout transforms.
-    No O(n x k) host or replicated array is ever formed."""
+    No O(n x k) host or replicated array is ever formed.
+
+    ``out_cols=True`` skips the final pack and returns the column-sharded
+    :class:`~dlaf_tpu.matrix.colpanels.ColPanels` carrier for a following
+    row-transform stage (sbr_back_transform) — eliding one all-to-all pair.
+    (May still return a DistributedMatrix on the trivial no-reflector
+    path; callers must accept either.)"""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -193,7 +200,7 @@ def bt_band_to_tridiagonal_hh_dist(
     if dt.kind == "c":
         ph[:n] = phases.astype(dt)
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (grid.cache_key, dist, n_pad, kpad, w, g, G, dt, prec)
+    key = (grid.cache_key, dist, n_pad, kpad, w, g, G, dt, prec, out_cols)
     if key not in _dist_cache:
 
         def loop(va, ta, of, e_loc):
@@ -213,10 +220,17 @@ def bt_band_to_tridiagonal_hh_dist(
             gp = phj[:, None] * gp
             gp = jax.lax.with_sharding_constraint(gp, NamedSharding(mesh, colspec))
             gp = sm(va, ta, of, gp)
+            if out_cols:
+                return gp
             return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
 
+        out_sh = (
+            NamedSharding(mesh, colspec) if out_cols else grid.stacked_sharding()
+        )
+        # donation only helps when output aliases input (stacked -> stacked);
+        # the col-sharded output can't alias, donating would only warn
         _dist_cache[key] = jax.jit(
-            run, out_shardings=grid.stacked_sharding(), donate_argnums=(0,)
+            run, out_shardings=out_sh, donate_argnums=() if out_cols else (0,)
         )
     with jax.default_matmul_precision(prec):
         data = _dist_cache[key](
@@ -226,6 +240,10 @@ def bt_band_to_tridiagonal_hh_dist(
             jnp.asarray(offs),
             jnp.asarray(ph),
         )
+    if out_cols:
+        from dlaf_tpu.matrix.colpanels import ColPanels
+
+        return ColPanels(data, n, k, grid, dist)
     return mat_e._inplace(data)
 
 

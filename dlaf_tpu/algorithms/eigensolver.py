@@ -94,7 +94,11 @@ def hermitian_eigensolver(
             )
             st.barrier(v.data)
         with st.stage("bt_band"):
-            e = bt_band_to_tridiagonal_hh_dist(hh, v)
+            # with an SBR stage following, hand E over column-sharded —
+            # fuses the two row-transform stages, eliding one all-to-all
+            # pair (ROADMAP item; may still yield a stacked matrix on the
+            # trivial no-reflector path, which sbr accepts)
+            e = bt_band_to_tridiagonal_hh_dist(hh, v, out_cols=tr_sbr is not None)
             st.barrier(e.data)
         if tr_sbr is not None:
             from dlaf_tpu.algorithms.band_reduction import sbr_back_transform

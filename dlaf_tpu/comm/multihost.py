@@ -28,6 +28,7 @@ APIs and carry no environment-specific logic.
 from __future__ import annotations
 
 _initialized = False
+_world_up = False  # a REAL jax.distributed world came up (vs a no-op)
 
 
 def initialize(
@@ -43,10 +44,13 @@ def initialize(
     left untouched — algorithms run exactly as before.  A later EXPLICIT
     call (with a coordinator address) overrides an earlier no-op.
     """
-    global _initialized
+    global _initialized, _world_up
     explicit = coordinator_address is not None
-    if _initialized and not explicit:
-        return  # an explicit call may still override an earlier no-op
+    if _initialized and (_world_up or not explicit):
+        # idempotent: repeated calls (explicit or not) after a successful
+        # bring-up no-op; only an explicit call may override an earlier
+        # single-process NO-OP
+        return
 
     import jax
 
@@ -56,6 +60,7 @@ def initialize(
             num_processes=num_processes,
             process_id=process_id,
         )
+        _world_up = True
     except ValueError:
         # jax's cluster autodetection (TPU pod metadata, SLURM, GKE, the
         # coordinator envs) found nothing and no explicit coordinator was

@@ -162,6 +162,11 @@ class DistributedMatrix:
         return np.asarray(layout.unpad_global(layout.unpack(x, self.dist), self.dist))
 
     def get_tile(self, gt) -> np.ndarray:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "get_tile indexes local shards and is single-process only; "
+                "on a multi-host world use to_global() (replicated gather)"
+            )
         gt = Index2D(*gt)
         r, c = self.dist.rank_global_tile(gt)
         li, lj = self.dist.local_tile_index(gt)
@@ -170,6 +175,11 @@ class DistributedMatrix:
         return t[: ts.rows, : ts.cols]
 
     def set_tile(self, gt, value: np.ndarray) -> None:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "set_tile updates local shards and is single-process only; "
+                "on a multi-host world rebuild with from_global()"
+            )
         gt = Index2D(*gt)
         r, c = self.dist.rank_global_tile(gt)
         li, lj = self.dist.local_tile_index(gt)
