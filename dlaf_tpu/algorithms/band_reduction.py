@@ -242,7 +242,7 @@ def _bt_chunk_loop(e_pad, qchunk, s_base, *, b1: int, b2: int, CH: int):
 _bt_cache = {}
 
 
-def sbr_back_transform(tr: SbrTransforms, mat_e):
+def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
     """E := Q_sbr E with E distributed: reshard to column panels (one
     all-to-all), stream the host-staged Q chunks through the device in
     reverse, apply each sweep's batched blocks locally, and reshard back —
@@ -252,7 +252,9 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
     ``mat_e`` may be a stacked DistributedMatrix OR the column-sharded
     :class:`~dlaf_tpu.matrix.colpanels.ColPanels` handed over by
     ``bt_band_to_tridiagonal_hh_dist(..., out_cols=True)`` — the fused
-    form skips one unpack+pack all-to-all pair between the two stages."""
+    form skips one unpack+pack all-to-all pair between the two stages.
+    ``out_cols=True`` likewise returns ColPanels for the next stage
+    (bt_reduction_to_band) instead of packing."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -264,7 +266,9 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
 
     in_cols = isinstance(mat_e, cpan.ColPanels)
     if tr.n_sweeps == 0:
-        return cpan.pack_to_matrix(mat_e) if in_cols else mat_e
+        if in_cols:
+            return mat_e if out_cols else cpan.pack_to_matrix(mat_e)
+        return mat_e
     if in_cols:
         n, k = mat_e.n, mat_e.k
     else:
@@ -323,13 +327,14 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
             # padded output (different shapes), donating only warns
             _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
         e_cols = _bt_cache[pre_key](mat_e.data)
-    post_key = ("post", grid.cache_key, dist, n_pad, kpad, dt)
-    if post_key not in _bt_cache:
+    if not out_cols and not in_cols:  # ColPanels exits pack via pack_to_matrix
+        post_key = ("post", grid.cache_key, dist, n_pad, kpad, dt)
+        if post_key not in _bt_cache:
 
-        def post(gp):
-            return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
+            def post(gp):
+                return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
 
-        _bt_cache[post_key] = jax.jit(post, out_shardings=grid.stacked_sharding())
+            _bt_cache[post_key] = jax.jit(post, out_shardings=grid.stacked_sharding())
     with jax.default_matmul_precision(prec):
         for (s0, q) in reversed(tr.chunks):
             CH = q.shape[0]
@@ -348,9 +353,9 @@ def sbr_back_transform(tr: SbrTransforms, mat_e):
                     sm, out_shardings=col_sh, donate_argnums=(0,)
                 )
             e_cols = _bt_cache[akey](e_cols, jnp.asarray(q), jnp.asarray(s0))
-    data = _bt_cache[post_key](e_cols)
+    if out_cols:
+        return cpan.ColPanels(e_cols, n, k, grid, dist)
     if in_cols:
-        from dlaf_tpu.matrix.matrix import DistributedMatrix
-
-        return DistributedMatrix(dist, grid, data)
+        return cpan.pack_to_matrix(cpan.ColPanels(e_cols, n, k, grid, dist))
+    data = _bt_cache[post_key](e_cols)
     return mat_e._inplace(data)

@@ -94,17 +94,20 @@ def hermitian_eigensolver(
             )
             st.barrier(v.data)
         with st.stage("bt_band"):
-            # with an SBR stage following, hand E over column-sharded —
-            # fuses the two row-transform stages, eliding one all-to-all
-            # pair (ROADMAP item; may still yield a stacked matrix on the
-            # trivial no-reflector path, which sbr accepts)
-            e = bt_band_to_tridiagonal_hh_dist(hh, v, out_cols=tr_sbr is not None)
+            # the whole back-transform chain (bt_band -> sbr -> bt_red2band)
+            # is row transforms over independent columns: hand E between
+            # stages COLUMN-SHARDED (ColPanels), packing back to the stacked
+            # layout exactly once at the end — elides the intermediate
+            # all-to-all pairs and the per-panel W psums of bt_red2band.
+            # (Trivial no-reflector paths may still yield a stacked matrix,
+            # which every stage accepts.)
+            e = bt_band_to_tridiagonal_hh_dist(hh, v, out_cols=True)
             st.barrier(e.data)
         if tr_sbr is not None:
             from dlaf_tpu.algorithms.band_reduction import sbr_back_transform
 
             with st.stage("bt_sbr"):
-                e = sbr_back_transform(tr_sbr, e)
+                e = sbr_back_transform(tr_sbr, e, out_cols=True)
                 st.barrier(e.data)
         with st.stage("bt_red2band"):
             e = bt_reduction_to_band(e, band_mat, taus)
