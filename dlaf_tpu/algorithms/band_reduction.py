@@ -327,14 +327,7 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
             # padded output (different shapes), donating only warns
             _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
         e_cols = _bt_cache[pre_key](mat_e.data)
-    if not out_cols and not in_cols:  # ColPanels exits pack via pack_to_matrix
-        post_key = ("post", grid.cache_key, dist, n_pad, kpad, dt)
-        if post_key not in _bt_cache:
-
-            def post(gp):
-                return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
-
-            _bt_cache[post_key] = jax.jit(post, out_shardings=grid.stacked_sharding())
+    # all stacked exits pack through the one shared jit in colpanels
     with jax.default_matmul_precision(prec):
         for (s0, q) in reversed(tr.chunks):
             CH = q.shape[0]
@@ -355,7 +348,5 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
             e_cols = _bt_cache[akey](e_cols, jnp.asarray(q), jnp.asarray(s0))
     if out_cols:
         return cpan.ColPanels(e_cols, n, k, grid, dist)
-    if in_cols:
-        return cpan.pack_to_matrix(cpan.ColPanels(e_cols, n, k, grid, dist))
-    data = _bt_cache[post_key](e_cols)
-    return mat_e._inplace(data)
+    out = cpan.pack_to_matrix(cpan.ColPanels(e_cols, n, k, grid, dist))
+    return out if in_cols else mat_e._inplace(out.data)

@@ -78,6 +78,29 @@ def main(argv=None):
         (v, taus),
         bt * nb**3,  # dominated by V^H V
     )
+    # device wavefront bulge chase (band_chase_device): full chase at band
+    # 32 over an n = batch*nb band matrix — the HEEV band-stage inner
+    # kernel (opt-in: --kernels band_chase, use a small --nreps)
+    from dlaf_tpu.algorithms.band_chase_device import device_chase_hh
+
+    bband = 32
+    nch = bt * nb
+    abh = np.zeros((bband + 2, nch), np.dtype(dtype))
+    rng_ = np.random.default_rng(7)
+    abh[0] = 4.0 + rng_.standard_normal(nch)
+    for dd in range(1, bband + 1):
+        row = rng_.standard_normal(nch).astype(np.dtype(dtype))
+        if np.dtype(dtype).kind == "c":
+            row = row + 1j * rng_.standard_normal(nch)
+        abh[dd, : nch - dd] = row[: nch - dd]
+
+    runners["band_chase"] = (
+        lambda: jnp.asarray(device_chase_hh(abh, bband, want_q=False)[0]),
+        (),
+        # O(n^2 b): ~n^2/(2b) chase units total, each a 2b x 2b two-sided
+        # update (~8 b^2 flops) => ~4 n^2 b
+        4.0 * bband * nch * nch,
+    )
 
     for name in args.kernels.split(","):
         if name not in runners:

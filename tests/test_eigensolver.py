@@ -306,3 +306,20 @@ def test_heev_hegv_medium_n_pipeline(grid_2x4):
     matb = DistributedMatrix.from_global(grid_2x4, np.tril(b), (nb, nb))
     gres = hermitian_generalized_eigensolver("L", mat, matb)
     check_eig(a, gres.eigenvalues, gres.eigenvectors.to_global(), b=b)
+
+
+@pytest.mark.slow
+def test_heev_complex_medium_n(grid_2x4):
+    """Complex pipeline at a non-toy size (c64, N=512): deflation
+    tolerances, phase normalization, and the fused back-transform chain in
+    complex arithmetic above the default-tier sizes."""
+    m, nb = 512, 64
+    a = tu.random_hermitian_pd(m, np.complex64, seed=512)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    res = hermitian_eigensolver("L", mat, backend="pipeline")
+    evals_ref = np.linalg.eigvalsh(a.astype(np.complex128))
+    np.testing.assert_allclose(
+        res.eigenvalues, evals_ref, rtol=0,
+        atol=tu.tol_for(np.complex64, m, 50.0) * np.abs(evals_ref).max(),
+    )
+    check_eig(a, res.eigenvalues, res.eigenvectors.to_global())

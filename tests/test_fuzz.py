@@ -120,3 +120,33 @@ def test_fuzz_hemm(comm_grids, trial):
     mc = DistributedMatrix.from_global(grid, c, (nb, nb))
     out = hermitian_multiplication(t.LEFT, "L", 1.0, ma, mb, 0.5, mc)
     tu.assert_near(out, h @ b + 0.5 * c, tu.tol_for(dtype, m, 200.0))
+
+
+@pytest.mark.parametrize("trial", range(10))
+def test_fuzz_windows(comm_grids, trial):
+    """Random non-aligned windows: extract/update round-trips and the
+    sub_matrix dispatch (incl. random source ranks) against numpy slicing."""
+    from dlaf_tpu.matrix.util import sub_matrix
+    from dlaf_tpu.matrix.window import window_extract, window_update
+
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    n = int(RNG.integers(1, 40))
+    a = tu.random_matrix(m, n, dtype, seed=trial + 40)
+    r0 = int(RNG.integers(0, m))
+    c0 = int(RNG.integers(0, n))
+    h = int(RNG.integers(1, m - r0 + 1))
+    w = int(RNG.integers(1, n - c0 + 1))
+    mat = DistributedMatrix.from_global(grid, a, (nb, nb))
+    got = window_extract(mat, (r0, c0), (h, w)).to_global()
+    np.testing.assert_array_equal(got, a[r0 : r0 + h, c0 : c0 + w])
+    wnew = tu.random_matrix(h, w, dtype, seed=trial + 41)
+    upd = window_update(mat, (r0, c0), DistributedMatrix.from_global(grid, wnew, (nb, nb)))
+    want = a.copy()
+    want[r0 : r0 + h, c0 : c0 + w] = wnew
+    np.testing.assert_array_equal(upd.to_global(), want)
+    # sub_matrix with a random source rank takes the layout fallback
+    pr, pc = grid.grid_size
+    src = (int(RNG.integers(pr)), int(RNG.integers(pc)))
+    mat_s = DistributedMatrix.from_global(grid, a, (nb, nb), source_rank=src)
+    got2 = sub_matrix(mat_s, (r0, c0), (h, w)).to_global()
+    np.testing.assert_array_equal(got2, a[r0 : r0 + h, c0 : c0 + w])
