@@ -106,6 +106,8 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
     p.add_argument("--nruns", type=int, default=3)
     p.add_argument("--nwarmups", type=int, default=1)
     p.add_argument("--type", choices="sdcz", default="d")
+    p.add_argument("--uplo", choices=["L", "U"], default="L",
+                   help="triangle holding the input (reference MiniappOptions --uplo)")
     p.add_argument("--check", choices=["none", "last", "all"], default="none")
     p.add_argument(
         "--trace", default="", metavar="DIR",
@@ -132,6 +134,11 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "instrumented pipelines: eigensolver / gen_eigensolver",
     )
     return p
+
+
+def tri(uplo: str):
+    """The triangle extractor for ``uplo`` ('L' -> np.tril, 'U' -> np.triu)."""
+    return np.tril if uplo == "L" else np.triu
 
 
 def host_input(args, dtype, gen):

@@ -24,15 +24,18 @@ def main(argv=None):
     dtype = common.DTYPES[args.type]
     a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
 
+    uplo = args.uplo
+
     def make_input():
-        return DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
+        return DistributedMatrix.from_global(grid, common.tri(uplo)(a), (args.mb, args.mb))
 
     def run(mat):
-        return cholesky_factorization("L", mat)
+        return cholesky_factorization(uplo, mat)
 
     def check(out):
-        expected = np.linalg.cholesky(a)
-        tu.assert_near(out, expected, tu.tol_for(dtype, args.m, 100.0), uplo="L")
+        l = np.linalg.cholesky(a)
+        expected = l if uplo == "L" else l.conj().T
+        tu.assert_near(out, expected, tu.tol_for(dtype, args.m, 100.0), uplo=uplo)
 
     return common.run_timed(args, make_input, run, check, flops, name="cholesky")
 

@@ -28,13 +28,15 @@ def main(argv=None):
     dtype = common.DTYPES[args.type]
     a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
 
+    uplo = args.uplo
+
     def make_input():
-        return DistributedMatrix.from_global(grid, np.tril(a), (args.mb, args.mb))
+        return DistributedMatrix.from_global(grid, common.tri(uplo)(a), (args.mb, args.mb))
 
     box = {}
 
     def run(mat):
-        res = hermitian_eigensolver("L", mat)
+        res = hermitian_eigensolver(uplo, mat)
         box["res"] = res
         return res.eigenvectors
 

@@ -27,16 +27,17 @@ def main(argv=None):
     # --input-file supplies A; B stays generated (SPD, seeded)
     a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
     b = tu.random_hermitian_pd(args.m, dtype, seed=2)
-    mat_b_src = np.tril(b)
+    uplo = args.uplo
+    mat_b_src = common.tri(uplo)(b)
 
     def make_input():
-        return DistributedMatrix.from_global(grid, np.tril(a), (args.mb, args.mb))
+        return DistributedMatrix.from_global(grid, common.tri(uplo)(a), (args.mb, args.mb))
 
     box = {}
 
     def run(mat_a):
         mat_b = DistributedMatrix.from_global(grid, mat_b_src, (args.mb, args.mb))
-        res = hermitian_generalized_eigensolver("L", mat_a, mat_b)
+        res = hermitian_generalized_eigensolver(uplo, mat_a, mat_b)
         box["res"] = res
         return res.eigenvectors
 

@@ -32,6 +32,11 @@ def main(argv=None):
     p = common.miniapp_parser(__doc__)
     args = p.parse_args(argv)
     common.reject_input_file(args, name)
+    if args.uplo != "L":
+        raise SystemExit(
+            f"--uplo U is not supported by the {name} suite kernel (the "
+            "dedicated drivers support it; the suite benchmarks the L paths)"
+        )
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
     m, mb = args.m, args.mb

@@ -29,16 +29,18 @@ def main(argv=None):
         args.n = args.m
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
-    a = tu.random_triangular(args.m, dtype, lower=True, seed=1)
+    lower = args.uplo == "L"
+    a = tu.random_triangular(args.m, dtype, lower=lower, seed=1)
     b = tu.random_matrix(args.m, args.n, dtype, seed=2)
 
     def make_input():
         return DistributedMatrix.from_global(grid, b, (args.mb, args.mb))
 
     mat_a = DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
+    uplo_t = t.LOWER if lower else t.UPPER
 
     def run(mat_b):
-        return triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
+        return triangular_solver(t.LEFT, uplo_t, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b)
 
     def check(out):
         x = out.to_global()

@@ -71,3 +71,12 @@ def test_miniapp_input_output_file(tmp_path):
     with h5py.File(pout, "r") as f:
         lout = np.tril(f["a"][()])
     np.testing.assert_allclose(lout, np.linalg.cholesky(a), atol=1e-10)
+
+
+def test_miniapp_uplo_upper():
+    """--uplo U through the four dedicated drivers (reference
+    MiniappOptions --uplo)."""
+    for mod in (miniapp_cholesky, miniapp_eigensolver,
+                miniapp_gen_eigensolver, miniapp_triangular_solver):
+        res = mod.main(ARGS + ["--check", "last", "--uplo", "U"])
+        assert len(res) == 1
