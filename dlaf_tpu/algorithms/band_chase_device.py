@@ -182,7 +182,7 @@ def device_chase_hh(
     import jax
     import jax.numpy as jnp
 
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     b = int(band)
     n = ab_host.shape[1]
@@ -210,7 +210,7 @@ def device_chase_hh(
     V = np.zeros((R, b), dt)
     tau = np.zeros(R, dt)
     prec = get_tune_parameters().eigensolver_matmul_precision
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         for s0 in range(0, nsweeps, SB):
             s1 = min(nsweeps, s0 + SB)
             counts = np.array(

@@ -172,7 +172,7 @@ def sbr_reduce(ab_host: np.ndarray, b1: int, b2: int, want_q: bool = True):
     import jax
     import jax.numpy as jnp
 
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     n = ab_host.shape[1]
     dt = ab_host.dtype
@@ -192,7 +192,7 @@ def sbr_reduce(ab_host: np.ndarray, b1: int, b2: int, want_q: bool = True):
     eye = np.eye(b1, dtype=dt)
     ab = jnp.asarray(ab0)
     out_chunks: List[Tuple[int, np.ndarray]] = []
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         for (s0, s1, K) in chunks:
             CH = s1 - s0
             key = (np.dtype(dt), b1, b2, n_pad, CH, K, prec, want_q)
@@ -262,7 +262,7 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
     from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
     from dlaf_tpu.matrix import colpanels as cpan
     from dlaf_tpu.matrix import layout
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     in_cols = isinstance(mat_e, cpan.ColPanels)
     if tr.n_sweeps == 0:
@@ -328,7 +328,7 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
             _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
         e_cols = _bt_cache[pre_key](mat_e.data)
     # all stacked exits pack through the one shared jit in colpanels
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         for (s0, q) in reversed(tr.chunks):
             CH = q.shape[0]
             K = q.shape[1] - 1

@@ -168,7 +168,7 @@ def bt_band_to_tridiagonal_hh_dist(
     from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
     from dlaf_tpu.matrix import layout
 
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     d, e_, phases, v_refl, taus, band = hh
     grid = mat_e.grid
@@ -232,7 +232,7 @@ def bt_band_to_tridiagonal_hh_dist(
         _dist_cache[key] = jax.jit(
             run, out_shardings=out_sh, donate_argnums=() if out_cols else (0,)
         )
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         data = _dist_cache[key](
             mat_e.data,
             jnp.asarray(V_all),
@@ -267,7 +267,7 @@ def bt_band_to_tridiagonal_hh(
         e_host = phases[:, None] * e_host
     if v_refl.shape[0] == 0 or n == 0 or k == 0:
         return DistributedMatrix.from_global(grid, e_host, block_size)
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     if group_size is None:
         group_size = get_tune_parameters().bt_band_hh_group_size
@@ -284,6 +284,6 @@ def bt_band_to_tridiagonal_hh(
         dist_key=(grid.cache_key, dist), dist=dist, sharding=grid.stacked_sharding(),
         prec=prec,
     )
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         data = fn(jnp.asarray(e_pad), jnp.asarray(V_all), jnp.asarray(tau_all), jnp.asarray(offs))
     return DistributedMatrix(dist, grid, data)

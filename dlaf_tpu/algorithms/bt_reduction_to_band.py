@@ -109,7 +109,7 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
 
     from dlaf_tpu.matrix import colpanels as cpan
     from dlaf_tpu.matrix import layout
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     g_a = _spmd.Geometry.of(mat_band.dist)
     g_e = _spmd.Geometry.of(cols.dist)
@@ -157,7 +157,7 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
 
         # no donation: the col-sharded input cannot alias the stacked output
         _cache[key] = jax.jit(run, out_shardings=grid.stacked_sharding())
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         data = _cache[key](mat_band.data, taus, cols.data)
     return DistributedMatrix(dist, grid, data)
 
@@ -188,12 +188,12 @@ def bt_reduction_to_band(
         taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
     )
     taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
     key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec)
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))

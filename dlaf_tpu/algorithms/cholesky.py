@@ -231,6 +231,8 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
 
     from dlaf_tpu.matrix import layout
 
+    from dlaf_tpu.tune import blas3_precision
+
     dist = mat_a.dist
     key = (dist, np.dtype(mat_a.dtype), uplo)
     if key not in _local_cache:
@@ -249,7 +251,8 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
             return layout.pack(layout.pad_global(out, dist), dist)
 
         _local_cache[key] = run
-    return mat_a._inplace(_local_cache[key](mat_a.data))
+    with blas3_precision():
+        return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
 def cholesky_factorization(
@@ -285,7 +288,10 @@ def cholesky_factorization(
         from dlaf_tpu.tune import get_tune_parameters
 
         variant = "lookahead" if get_tune_parameters().cholesky_lookahead else "bucketed"
-        data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
+        from dlaf_tpu.tune import blas3_precision
+
+        with blas3_precision():
+            data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
         return mat_a._inplace(data)
     if uplo == t.UPPER:
         # A = U^H U with U = L^H: mirror the stored upper triangle to lower

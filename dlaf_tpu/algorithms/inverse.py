@@ -120,6 +120,8 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
 
     from dlaf_tpu.matrix import layout
 
+    from dlaf_tpu.tune import blas3_precision
+
     dist = mat_a.dist
     key = (dist, str(mat_a.dtype), uplo, diag)
     if key not in _local_cache:
@@ -137,7 +139,8 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
             return layout.pack(layout.pad_global(out, dist), dist)
 
         _local_cache[key] = run
-    return mat_a._inplace(_local_cache[key](mat_a.data))
+    with blas3_precision():
+        return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
 def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
@@ -150,13 +153,16 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         return mat_a
     if mat_a.grid.grid_size.count() == 1:
         return _trtri_single_device(uplo, diag, mat_a)
+    from dlaf_tpu.tune import blas3_precision
+
     key = (mat_a.grid.cache_key, uplo, diag, g)
     if key not in _cache:
         kern_fn = _trtri_lower_kernel if uplo == t.LOWER else _trtri_upper_kernel
         _cache[key] = coll.spmd(
             mat_a.grid, partial(kern_fn, g=g, diag=diag), donate_argnums=(0,)
         )
-    return mat_a._inplace(_cache[key](mat_a.data))
+    with blas3_precision():
+        return mat_a._inplace(_cache[key](mat_a.data))
 
 
 def inverse_from_cholesky_factor(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:

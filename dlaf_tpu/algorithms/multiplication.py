@@ -179,6 +179,8 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
     """1x1-grid fast path: one dense GEMM instead of the SUMMA loop."""
     import jax
 
+    from dlaf_tpu.tune import blas3_precision
+
     da, db, dc = mat_a.dist, mat_b.dist, mat_c.dist
     key = (
         "local", da, db, dc, np.dtype(mat_c.dtype), opa, opb,
@@ -199,10 +201,13 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
             return layout.pack(layout.pad_global(out.astype(gc.dtype), dc), dc)
 
         _local_cache[key] = run
-    return mat_c._inplace(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    with blas3_precision():
+        return mat_c._inplace(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
+    from dlaf_tpu.tune import blas3_precision
+
     g_a = _spmd.Geometry.of(mat_a.dist)
     g_b = _spmd.Geometry.of(mat_b.dist)
     g_c = _spmd.Geometry.of(mat_c.dist)
@@ -220,7 +225,8 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
         _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
-    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    with blas3_precision():
+        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def general_multiplication(
@@ -331,6 +337,8 @@ def _a_row_panel(a, k, g_a, myr, myc, op, structure, diag, ltc_out, nt_out):
 
 
 def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0):
+    from dlaf_tpu.tune import blas3_precision
+
     g_a = _spmd.Geometry.of(mat_a.dist)
     g_b = _spmd.Geometry.of(mat_b.dist)
     g_c = _spmd.Geometry.of(mat_c.dist)
@@ -349,7 +357,8 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
         _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
-    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    with blas3_precision():
+        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _sub_gemm_kernel(
@@ -508,6 +517,8 @@ def general_sub_multiplication(
     # updating one window of a matrix from another) — donating C's buffer
     # would then alias a live operand, so compile a non-donating variant
     aliased = (mat_a.data is mat_c.data) or (mat_b.data is mat_c.data)
+    from dlaf_tpu.tune import blas3_precision
+
     key = (
         "subgemm", mat_c.grid.cache_key, complex(alpha), complex(beta),
         origins, Ri, Rj, Rk, g_a, g_b, g_c, aliased,
@@ -522,12 +533,15 @@ def general_sub_multiplication(
         _cache[key] = coll.spmd(
             mat_c.grid, kern, donate_argnums=() if aliased else (2,)
         )
-    return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+    with blas3_precision():
+        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
 def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
     """1x1-grid fast path: slice the three global windows, one dense GEMM."""
     import jax
+
+    from dlaf_tpu.tune import blas3_precision
 
     da, db, dc = a_ref.parent.dist, b_ref.parent.dist, c_ref.parent.dist
     oa, ob, oc = tuple(a_ref.origin), tuple(b_ref.origin), tuple(c_ref.origin)
@@ -550,9 +564,10 @@ def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
             return layout.pack(layout.pad_global(gc, dc), dc)
 
         _local_cache[key] = run
-    return c_ref.parent._inplace(
-        _local_cache[key](a_ref.parent.data, b_ref.parent.data, c_ref.parent.data)
-    )
+    with blas3_precision():
+        return c_ref.parent._inplace(
+            _local_cache[key](a_ref.parent.data, b_ref.parent.data, c_ref.parent.data)
+        )
 
 
 def _check_mult_shapes(opa, opb, mat_a, mat_b, mat_c):

@@ -235,7 +235,7 @@ def get_band_size(nb: int) -> int:
     (reference: eigensolver/internal/get_band_size.h:20).  A band smaller
     than the tile decouples the O(N^2 b) host bulge-chasing cost from the
     MXU-shaped tile size."""
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     b_min = max(2, int(get_tune_parameters().eigensolver_min_band))
     for div in range(nb // b_min, 1, -1):
@@ -264,14 +264,14 @@ def reduction_to_band(
     full = mutil.hermitize(mat_a, "L")
     if n_panels == 0:
         return full, jnp.zeros((0, band), mat_a.dtype)
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
     key = (mat_a.grid.cache_key, g, band, prec)
     if key not in _cache:
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         data, taus_stack = _cache[key](full.data)
     full.data = data  # the hermitized copy was donated
     out = mat_a.like(data)

@@ -301,6 +301,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
 
     from dlaf_tpu.matrix import layout
 
+    from dlaf_tpu.tune import blas3_precision
+
     da, db = mat_a.dist, mat_b.dist
     key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha))
     if key not in _local_cache:
@@ -313,7 +315,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
             return layout.pack(layout.pad_global(out, db), db)
 
         _local_cache[key] = run
-    return mat_b._inplace(_local_cache[key](mat_a.data, mat_b.data))
+    with blas3_precision():
+        return mat_b._inplace(_local_cache[key](mat_a.data, mat_b.data))
 
 
 def triangular_solver(
@@ -357,8 +360,11 @@ def triangular_solver(
         kern_fn = _trsm_left_lookahead_kernel if lookahead else _trsm_left_bucketed_kernel
     else:
         kern_fn = _trsm_right_kernel
+    from dlaf_tpu.tune import blas3_precision
+
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b, lookahead)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))
-    return mat_b._inplace(_cache[key](mat_a.data, mat_b.data))
+    with blas3_precision():
+        return mat_b._inplace(_cache[key](mat_a.data, mat_b.data))

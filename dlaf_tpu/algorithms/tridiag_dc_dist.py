@@ -566,7 +566,7 @@ def tridiag_dc_distributed(
     ``spectrum`` slice width.  Eigenvectors are computed in the real dtype
     matching ``dtype`` and cast on device for complex callers."""
     from dlaf_tpu.matrix import util as mutil
-    from dlaf_tpu.tune import get_tune_parameters
+    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     rdt = (
         np.float32
@@ -619,7 +619,7 @@ def tridiag_dc_distributed(
         )
     dm_dev = jnp.asarray(d_mod)
     ep_dev = jnp.asarray(e_pad)
-    with jax.default_matmul_precision(prec):
+    with matmul_precision(prec):
         x, lam = _cache[("leaf",) + key0](dm_dev, ep_dev)
 
     for lvl in range(L):
@@ -639,7 +639,7 @@ def tridiag_dc_distributed(
                 in_specs=(stacked, rep, rep),
                 out_specs=tuple([rep] * 16),
             )
-        with jax.default_matmul_precision(prec):
+        with matmul_precision(prec):
             prm = _cache[pkey](x, lam, beta_l)
         lam = prm[0]
         has_rot = bool(prm[15])
@@ -652,7 +652,7 @@ def tridiag_dc_distributed(
                 out_specs=stacked,
                 donate=(0,),
             )
-        with jax.default_matmul_precision(prec):
+        with matmul_precision(prec):
             x = _cache[gkey](x, *prm[1:15])
 
     w = np.asarray(lam)[:n]

@@ -44,12 +44,18 @@ class TuneParameters:
       tridiagonal solver (rounded to a tile multiple; subproblem sizes are
       this times powers of two).
     - ``eigensolver_matmul_precision``: JAX matmul precision for the
-      eigensolver pipeline stages ('float32' | 'bfloat16_3x' | 'bfloat16').
+      eigensolver pipeline stages ('float32' | 'high' | 'bfloat16';
+      'bfloat16_3x' is accepted as an alias of 'high' = three bf16 MXU
+      passes).
       TPU MXU f32 matmuls default to bf16 passes (eps ~8e-3), which would
       destroy eigenvector orthogonality; the eigensolver traces its kernels
-      under full-f32 precision by default.  General BLAS-style ops (GEMM,
-      POTRF, TRSM) follow JAX's global default so throughput-focused users
-      keep the fast path.
+      under full-f32 precision by default.
+    - ``blas3_matmul_precision``: the same lever for the BLAS-3 family
+      (POTRF/TRSM/GEMM/TRMM/HEMM/TRTRI/POTRI/HEGST).  Default 'default'
+      keeps JAX's global setting — the fast MXU path on TPU, which the
+      round-1 on-chip residual checks passed — so throughput users change
+      nothing; accuracy-critical users set 'float32' (or 'high' ==
+      bf16_3x) per call or via DLAF_TPU_BLAS3_MATMUL_PRECISION.
     - ``cholesky_lookahead``: use the lookahead SPMD kernel (panel k+1
       overlapped with the bulk trailing update — benefits multi-chip
       meshes; the bucketed kernel is the single-chip default).
@@ -83,6 +89,9 @@ class TuneParameters:
     dc_leaf_size: int = field(default_factory=lambda: _env("dc_leaf_size", 512, int))
     eigensolver_matmul_precision: str = field(
         default_factory=lambda: _env("eigensolver_matmul_precision", "float32", str)
+    )
+    blas3_matmul_precision: str = field(
+        default_factory=lambda: _env("blas3_matmul_precision", "default", str)
     )
     band_chase_backend: str = field(
         default_factory=lambda: _env("band_chase_backend", "auto", str)
@@ -124,3 +133,31 @@ def initialize(**overrides) -> TuneParameters:
     global _params
     _params = TuneParameters()
     return _params.update(**overrides)
+
+
+# user-facing spellings -> jax.default_matmul_precision enum values
+# ('high' == three bf16 passes on TPU MXU, 'highest'/'float32' == six)
+_PRECISION_ALIASES = {"bfloat16_3x": "high", "bf16_3x": "high", "f32": "float32"}
+
+
+def normalize_matmul_precision(p: str) -> str:
+    return _PRECISION_ALIASES.get(p, p)
+
+
+def matmul_precision(p: str):
+    """Context manager for a matmul-precision string ('' / 'default' =
+    no-op, keeping JAX's global setting; aliases normalized)."""
+    import contextlib
+
+    p = normalize_matmul_precision(p)
+    if p in ("", "default"):
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_matmul_precision(p)
+
+
+def blas3_precision():
+    """Context manager applying ``blas3_matmul_precision`` around a BLAS-3
+    kernel call."""
+    return matmul_precision(get_tune_parameters().blas3_matmul_precision)

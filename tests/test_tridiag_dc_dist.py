@@ -139,3 +139,30 @@ def test_dc_dist_pathological_clustering_4096(grid_2x4):
         _check(grid_2x4, d, e, 256, np.float64)
     finally:
         tp.dc_leaf_size = old
+
+
+@pytest.mark.parametrize("leaf_size", [16], indirect=True)
+def test_dc_dist_glued_wilkinson(grid_2x4, leaf_size):
+    """Glued Wilkinson W21+ matrices — the classic D&C stressor: pairs of
+    eigenvalues agree to ~1e-14 across glue points, forcing heavy
+    deflation interplay with near-equal secular roots (reference analogue:
+    the tridiag solver's clustered test matrices)."""
+    k = 21
+    glue = 1e-8
+    blocks = 3
+    n = k * blocks
+    d = np.tile(np.abs(np.arange(k) - (k - 1) / 2.0), blocks)
+    e = np.ones(n - 1)
+    for b in range(1, blocks):
+        e[b * k - 1] = glue
+    _check(grid_2x4, d, e, 16, np.float64, tol_factor=400)
+
+
+@pytest.mark.parametrize("leaf_size", [16], indirect=True)
+def test_dc_dist_zero_offdiag(grid_2x4, leaf_size):
+    """e == 0 exactly: every merge fully deflates (diagonal matrix in
+    disguise, random order)."""
+    rng = np.random.default_rng(12)
+    d = rng.permutation(np.arange(48.0))
+    e = np.zeros(47)
+    _check(grid_2x4, d, e, 16, np.float64)
