@@ -235,7 +235,7 @@ def get_band_size(nb: int) -> int:
     (reference: eigensolver/internal/get_band_size.h:20).  A band smaller
     than the tile decouples the O(N^2 b) host bulge-chasing cost from the
     MXU-shaped tile size."""
-    from dlaf_tpu.tune import get_tune_parameters, matmul_precision
+    from dlaf_tpu.tune import get_tune_parameters
 
     b_min = max(2, int(get_tune_parameters().eigensolver_min_band))
     for div in range(nb // b_min, 1, -1):

@@ -101,13 +101,14 @@ def main():
             "algo": algo, "n": n, "grid": gs, "ranks": pr * pc,
             "mb": args.mb, "dtype": args.type, "time_s": best, "gflops": gf,
         })
+        # write-through after EVERY config: a killed sweep keeps its rows
+        with open(args.out, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            w.writeheader()
+            w.writerows(rows)
     if not rows:
         print("no successful configs")
         return 1
-    with open(args.out, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
-        w.writeheader()
-        w.writerows(rows)
     print(f"wrote {args.out} ({len(rows)} rows)")
     return 0
 

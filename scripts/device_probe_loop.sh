@@ -1,9 +1,11 @@
 #!/bin/sh
 # Probe the TPU tunnel every ~5 min; append one line per attempt to the log.
-# On the FIRST success in any 45-min window, opportunistically capture real
-# benchmark numbers (bench.py + an HEEV stage breakdown) into bench_results/
-# — the tunnel has been dead during every scheduled bench window so far
-# (BENCH_r01..r03 all 0.0), so any moment of liveness must not be wasted.
+# On the FIRST success in any 4-hour window, launch the FULL measurement
+# campaign (scripts/tpu_day.sh, ordered most-important-first, <= ~3.9h)
+# into bench_results/ — the tunnel has been dead during every scheduled
+# bench window so far (BENCH_r01..r03 all 0.0), so a liveness window must
+# convert into the complete evidence set.  Probing pauses while the
+# campaign runs.
 LOG="${1:-/tmp/device_probe.log}"
 OUTDIR="${2:-/root/repo/bench_results}"
 mkdir -p "$OUTDIR"
@@ -19,16 +21,12 @@ print('ALIVE', float(jnp.sum(x @ x)), jax.devices()[0].platform)
     ALIVE*)
       echo "$TS $OUT" >> "$LOG"
       NOW=$(date +%s)
-      if [ $((NOW - LAST_BENCH)) -gt 2700 ]; then
+      if [ $((NOW - LAST_BENCH)) -gt 14400 ]; then
         LAST_BENCH=$NOW
         STAMP=$(date -u +%Y%m%d_%H%M%S)
-        echo "$TS starting opportunistic bench -> $OUTDIR/bench_$STAMP.json" >> "$LOG"
-        (cd /root/repo && timeout 500 python bench.py > "$OUTDIR/bench_$STAMP.json" 2>> "$LOG")
-        echo "$TS bench rc=$?" >> "$LOG"
-        (cd /root/repo && timeout 600 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
-          --m 4096 --mb 512 --type s --nruns 1 --stage-times \
-          > "$OUTDIR/heev_stages_$STAMP.txt" 2>&1)
-        echo "$TS heev stage run rc=$?" >> "$LOG"
+        echo "$TS starting tpu_day campaign -> $OUTDIR/tpu_day_$STAMP" >> "$LOG"
+        (cd /root/repo && timeout 14000 sh scripts/tpu_day.sh "$OUTDIR/tpu_day_$STAMP" >> "$LOG" 2>&1)
+        echo "$TS tpu_day rc=$?" >> "$LOG"
       fi
       ;;
     *) echo "$TS dead: $(echo "$OUT" | cut -c1-80)" >> "$LOG" ;;

@@ -1,0 +1,70 @@
+#!/bin/sh
+# The full TPU measurement campaign, one command, ordered so the most
+# important numbers land first (any wedge/crash still leaves artifacts).
+# Usage: sh scripts/tpu_day.sh [outdir]   (default bench_results/tpu_day)
+#
+# Every prior round's scheduled bench window found the tunnel dead
+# (BENCH_r01..r03: rc 124 with probe logs); this script exists so that any
+# window of chip liveness — however brief — converts into the complete
+# evidence set: headline bench, per-stage breakdowns, micro-kernels,
+# algorithm sweep, and the A/Bs that were only ever measured on the CPU
+# mesh (lookahead, SBR, matmul precision).
+set -x
+OUT="${1:-bench_results/tpu_day}"
+cd "$(dirname "$0")/.."
+mkdir -p "$OUT"
+
+# 0. liveness + environment
+timeout 60 python -c "
+import jax, jax.numpy as jnp, numpy as np
+x = jnp.ones((256, 256), np.float32)
+print('ALIVE', float(jnp.sum(x @ x)), jax.devices())
+" > "$OUT/00_probe.txt" 2>&1 || exit 1
+
+# 1. headline bench artifact (staged POTRF + HEEV, retry-probe protocol)
+timeout 500 python bench.py > "$OUT/01_bench.json" 2> "$OUT/01_bench.err"
+
+# 2. HEEV per-stage breakdown at increasing N (the round-2 'where does a
+#    second go' question), device wavefront chase + SBR engaged by default
+for N in 4096 8192 16384; do
+  timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    --m $N --mb 512 --type s --nruns 1 --stage-times \
+    > "$OUT/02_heev_stages_n$N.txt" 2>&1 || break
+done
+
+# 3. micro-kernels (incl. the Pallas potrf tile and the wavefront chase)
+timeout 600 python -m dlaf_tpu.miniapp.kernel_runner --nb 256 --batch 16 \
+  --kernels potrf,potrf_pallas,trsm,gemm,tfactor > "$OUT/03_kernels.txt" 2>&1
+timeout 900 python -m dlaf_tpu.miniapp.kernel_runner --nb 256 --batch 16 \
+  --nreps 2 --kernels band_chase > "$OUT/03_band_chase.txt" 2>&1
+
+# 4. per-algorithm sweep (single chip; CSV written through after every
+#    config, so a timeout keeps the finished rows)
+timeout 3600 python scripts/bench_sweep.py --algos cholesky,trsm,trmm,hemm,potri,heev \
+  --grids 1x1 --sizes 4096,8192,16384 --mb 512 --nruns 2 --timeout 450 \
+  --out "$OUT/04_sweep.csv" > "$OUT/04_sweep.log" 2>&1
+
+# 5. A/Bs measured only on the CPU mesh so far
+#    (a) lookahead on/off
+for LA in 0 1; do
+  DLAF_TPU_CHOLESKY_LOOKAHEAD=$LA timeout 600 python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    --m 8192 --mb 512 --type s --nruns 2 > "$OUT/05_potrf_lookahead$LA.txt" 2>&1
+done
+#    (b) SBR band shrink on/off at the HEEV band stage
+for SBR in 0 32; do
+  DLAF_TPU_EIGENSOLVER_SBR_BAND=$SBR timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    --m 8192 --mb 512 --type s --nruns 1 --stage-times \
+    > "$OUT/05_heev_sbr$SBR.txt" 2>&1
+done
+#    (c) BLAS-3 matmul precision: MXU fast path vs full f32 passes
+for P in default high float32; do
+  DLAF_TPU_BLAS3_MATMUL_PRECISION=$P timeout 600 python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    --m 8192 --mb 512 --type s --nruns 2 --check last \
+    > "$OUT/05_potrf_prec_$P.txt" 2>&1
+done
+
+# 6. one profiler trace for the record
+timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \
+  --type s --nruns 1 --trace "$OUT/06_trace" > "$OUT/06_trace.log" 2>&1
+
+echo "tpu_day artifacts in $OUT"
