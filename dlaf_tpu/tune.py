@@ -65,6 +65,13 @@ class TuneParameters:
       band/sbr_band.  0 disables; -1 (default) = auto: 32 when the default
       JAX backend is an accelerator, off on CPU (measured: the CPU-mesh
       "device" stage costs more than the host chase it saves).
+    - ``gen_to_std_backend``: 'composed' (two full triangular solves,
+      2 N^3 — the measured default: 1.16 s vs the fused 1.75 s at N=2048
+      on the 8-device mesh) or 'fused' (LAPACK hegst tile recursion with
+      the trailing solve deferred to one trsm — fewer true flops at
+      ~1.67 N^3, but its her2k windows over-approximate in BOTH grid
+      dimensions under the halving buckets, eating the advantage; see
+      docs/BENCHMARKS.md).  1x1 grids always take the composed route.
     - ``band_chase_backend``: where the small-band -> tridiagonal bulge
       chase runs: 'native' (threaded C++ host kernel), 'device' (batched
       wavefront on the accelerator, algorithms/band_chase_device.py), or
@@ -92,6 +99,9 @@ class TuneParameters:
     )
     blas3_matmul_precision: str = field(
         default_factory=lambda: _env("blas3_matmul_precision", "default", str)
+    )
+    gen_to_std_backend: str = field(
+        default_factory=lambda: _env("gen_to_std_backend", "composed", str)
     )
     band_chase_backend: str = field(
         default_factory=lambda: _env("band_chase_backend", "auto", str)

@@ -66,3 +66,34 @@ def test_gen_to_std_with_cholesky_pipeline(grid_2x4):
     l = np.linalg.cholesky(b)
     expected = np.linalg.solve(l, a) @ np.linalg.inv(l.conj().T)
     tu.assert_near(out, expected, tu.tol_for(dtype, m, 500.0))
+
+
+def test_gen_to_std_fused_backend(comm_grids):
+    """The fused hegst backend (deferred trailing solve) against the
+    composed default on several grids/dtypes/sizes."""
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.tune import get_tune_parameters
+
+    tp = get_tune_parameters()
+    old = tp.gen_to_std_backend
+    try:
+        for grid in comm_grids[:3]:
+            for m, nb, dtype in [(24, 4, np.float64), (21, 5, np.complex128), (16, 8, np.float32)]:
+                for uplo in ("L", "U"):
+                    tri = np.tril if uplo == "L" else np.triu
+                    a = tu.random_hermitian_pd(m, dtype, seed=m)
+                    b = tu.random_hermitian_pd(m, dtype, seed=m + 1)
+                    fac = cholesky_factorization(
+                        uplo, DistributedMatrix.from_global(grid, tri(b), (nb, nb))
+                    )
+                    outs = {}
+                    for be in ("composed", "fused"):
+                        tp.gen_to_std_backend = be
+                        mat = DistributedMatrix.from_global(grid, tri(a), (nb, nb))
+                        outs[be] = generalized_to_standard(uplo, mat, fac).to_global()
+                    np.testing.assert_allclose(
+                        outs["fused"], outs["composed"], rtol=0,
+                        atol=tu.tol_for(dtype, m, 200.0) * max(1.0, np.abs(outs["composed"]).max()),
+                    )
+    finally:
+        tp.gen_to_std_backend = old
