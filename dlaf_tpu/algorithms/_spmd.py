@@ -55,14 +55,37 @@ class Geometry:
         )
 
 
-def halving_segments(n: int):
-    """Panel-index segments [k0, k1) that halve so each runs with a static
-    trailing-window bucket: ~log2(n) segments, <=2x flop overapproximation.
-    Shared by the bucketed cholesky/trsm/red2band kernels."""
+def bucket_ratio() -> float:
+    """The active segment ratio (clamped exactly as halving_segments
+    applies it) — kernels that bake segments at trace time must include
+    this in their compile-cache keys."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    return max(1.01, float(get_tune_parameters().bucket_segment_ratio))
+
+
+def halving_segments(n: int, ratio: float | None = None):
+    """Panel-index segments [k0, k1) whose trailing extent shrinks by
+    ``ratio`` per segment, so each segment runs with one static
+    trailing-window bucket.  Shared by the bucketed cholesky/trsm/
+    red2band/hegst kernels.
+
+    ``ratio`` (default ``tune.bucket_segment_ratio``) trades compiled
+    variants for wasted flops: windows are sized for the segment START, so
+    the mean flop overapproximation of a 2-D trailing update is ~1.69x at
+    ratio 2 (the historical halving), ~1.35x at 1.414, ~1.23x at 1.26 —
+    at ~1.5x / ~2x the segment count (= compiled loop bodies)."""
+    if ratio is None:
+        from dlaf_tpu.tune import get_tune_parameters
+
+        ratio = float(get_tune_parameters().bucket_segment_ratio)
+    ratio = max(1.01, ratio)
     segs = []
     k0 = 0
     while k0 < n:
-        k1 = min(n, k0 + max(1, (n - k0 + 1) // 2))
+        k1 = min(n, n - int((n - k0) / ratio))
+        if k1 <= k0:
+            k1 = k0 + 1
         segs.append((k0, k1))
         k0 = k1
     return segs

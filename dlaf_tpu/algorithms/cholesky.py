@@ -208,7 +208,7 @@ _kernel_cache = {}
 
 
 def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
-    key = (grid.cache_key, g, uplo, variant)
+    key = (grid.cache_key, g, uplo, variant, _spmd.bucket_ratio())
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,

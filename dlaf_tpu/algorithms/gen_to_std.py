@@ -147,7 +147,7 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
         return mat_a_full
     if (g.mb, g.pr, g.pc, g.mt) != (g_b.mb, g_b.pr, g_b.pc, g_b.mt):
         raise ValueError("gen_to_std: A and B distributions must match")
-    key = ("phaseA", mat_a_full.grid.cache_key, g)
+    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio())
     if key not in _cache:
         _cache[key] = coll.spmd(
             mat_a_full.grid,

@@ -362,7 +362,8 @@ def triangular_solver(
         kern_fn = _trsm_right_kernel
     from dlaf_tpu.tune import blas3_precision
 
-    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b, lookahead)
+    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b,
+           lookahead, _spmd.bucket_ratio())
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

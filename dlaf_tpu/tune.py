@@ -72,6 +72,13 @@ class TuneParameters:
       ~1.67 N^3, but its her2k windows over-approximate in BOTH grid
       dimensions under the halving buckets, eating the advantage; see
       docs/BENCHMARKS.md).  1x1 grids always take the composed route.
+    - ``bucket_segment_ratio``: window-shrink factor per bucketed segment
+      (see _spmd.halving_segments) — smaller = tighter trailing windows
+      (fewer wasted einsum flops), more compiled loop bodies.  Mean 2-D
+      trailing-update overapproximation: ~1.69x at 2.0 (the historical
+      halving), ~1.35x at 1.414, ~1.23x at the 1.26 default — measured
+      +15-20% POTRF/TRSM steady-state at mt=32 for ~2x the one-time
+      compile (docs/BENCHMARKS.md round-4 section).
     - ``band_chase_backend``: where the small-band -> tridiagonal bulge
       chase runs: 'native' (threaded C++ host kernel), 'device' (batched
       wavefront on the accelerator, algorithms/band_chase_device.py), or
@@ -102,6 +109,9 @@ class TuneParameters:
     )
     gen_to_std_backend: str = field(
         default_factory=lambda: _env("gen_to_std_backend", "composed", str)
+    )
+    bucket_segment_ratio: float = field(
+        default_factory=lambda: _env("bucket_segment_ratio", 1.26, float)
     )
     band_chase_backend: str = field(
         default_factory=lambda: _env("band_chase_backend", "auto", str)
