@@ -75,11 +75,9 @@ def halving_segments(n: int, ratio: float | None = None):
     the mean flop overapproximation of a 2-D trailing update is ~1.69x at
     ratio 2 (the historical halving), ~1.35x at 1.414, ~1.23x at 1.26 —
     at ~1.5x / ~2x the segment count (= compiled loop bodies)."""
-    if ratio is None:
-        from dlaf_tpu.tune import get_tune_parameters
-
-        ratio = float(get_tune_parameters().bucket_segment_ratio)
-    ratio = max(1.01, ratio)
+    # single source for the default + clamp: the same helper kernels put in
+    # their compile-cache keys, so keys always match the traced segments
+    ratio = bucket_ratio() if ratio is None else max(1.01, ratio)
     segs = []
     k0 = 0
     while k0 < n:

@@ -208,7 +208,9 @@ _kernel_cache = {}
 
 
 def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
-    key = (grid.cache_key, g, uplo, variant, _spmd.bucket_ratio())
+    # only the bucketed variant bakes ratio-dependent segments
+    ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
+    key = (grid.cache_key, g, uplo, variant, ratio)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,

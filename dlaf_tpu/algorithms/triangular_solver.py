@@ -362,8 +362,10 @@ def triangular_solver(
         kern_fn = _trsm_right_kernel
     from dlaf_tpu.tune import blas3_precision
 
+    # only the left bucketed kernel bakes ratio-dependent segments
+    ratio = _spmd.bucket_ratio() if kern_fn is _trsm_left_bucketed_kernel else None
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b,
-           lookahead, _spmd.bucket_ratio())
+           lookahead, ratio)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

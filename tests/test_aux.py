@@ -87,3 +87,27 @@ def _run_check_level_cases(checks, grid_2x4):
     mat = DistributedMatrix.from_global(grid_2x4, bad, (4, 4))
     with pytest.raises(AssertionError, match="diagonal"):
         cholesky_factorization("L", mat)
+
+
+def test_halving_segments_ratios():
+    """Segment generator invariants at every ratio: exact [0, n) cover,
+    monotone, ratio 2.0 reproduces the historical halving."""
+    from dlaf_tpu.algorithms._spmd import bucket_ratio, halving_segments
+    from dlaf_tpu.tune import get_tune_parameters
+
+    for n in (1, 2, 3, 7, 32, 129):
+        for r in (2.0, 1.414, 1.26, 1.125, 1.01, 0.5):
+            segs = halving_segments(n, r)
+            assert segs[0][0] == 0 and segs[-1][1] == n
+            for (a0, a1), (b0, b1) in zip(segs, segs[1:]):
+                assert a1 == b0 and a1 > a0
+            assert segs[-1][1] > segs[-1][0]
+    assert halving_segments(32, 2.0) == [(0, 16), (16, 24), (24, 28), (28, 30), (30, 31), (31, 32)]
+    # the key helper returns the clamped value halving_segments actually uses
+    tp = get_tune_parameters()
+    old = tp.bucket_segment_ratio
+    try:
+        tp.bucket_segment_ratio = 0.3
+        assert bucket_ratio() == 1.01
+    finally:
+        tp.bucket_segment_ratio = old

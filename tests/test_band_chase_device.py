@@ -94,3 +94,34 @@ def test_heev_pipeline_device_chase(grid_2x4):
         assert np.abs(v.T @ v - np.eye(n)).max() < 1e-10 * n
     finally:
         tp.band_chase_backend, tp.eigensolver_sbr_band = old_be, old_sbr
+
+
+@pytest.mark.slow
+def test_device_chase_medium_n_multiblock():
+    """Medium-N chase (n=512, b=16, f64): several sweep BLOCKS (SB=128 <
+    510 sweeps), so cross-block carry and K bucketing are exercised at a
+    scale the default tier never reaches; checked against the native
+    threaded kernel and the eigenvalue oracle."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.native import band2trid_hh, get_lib
+
+    n, b = 512, 16
+    ab = _rand_band(n, b, np.float64, seed=99)
+    out = device_chase_hh(ab.copy(), b)
+    assert out is not None
+    d, e_raw, v, tau = out
+    # eigenvalues match the band matrix (oracle)
+    full = np.zeros((n, n))
+    for off in range(b + 1):
+        full += np.diag(ab[off, : n - off], -off)
+    full = full + np.tril(full, -1).T
+    w_ref = np.linalg.eigvalsh(full)
+    w_got = sla.eigh_tridiagonal(d, np.real(e_raw), eigvals_only=True)
+    np.testing.assert_allclose(np.sort(w_got), w_ref, atol=1e-10 * max(1, np.abs(w_ref).max()))
+    if get_lib() is not None:
+        dn, en, vn, taun = band2trid_hh(ab.copy(), b)
+        np.testing.assert_allclose(d, dn, atol=1e-11)
+        np.testing.assert_allclose(e_raw, en, atol=1e-11)
+        np.testing.assert_allclose(tau, taun, atol=1e-11)
+        np.testing.assert_allclose(v, vn, atol=1e-11)

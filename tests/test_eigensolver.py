@@ -358,3 +358,18 @@ def test_heev_degenerate_spectra(grid_2x4, kind):
     res = hermitian_eigensolver("L", mat, backend="pipeline")
     np.testing.assert_allclose(res.eigenvalues, w_ref, atol=1e-8)
     check_eig(a, res.eigenvalues, res.eigenvectors.to_global(), tol=1e-7)
+
+
+@pytest.mark.parametrize("m", [0, 1, 2, 3])
+def test_heev_tiny_sizes(grid_2x4, m):
+    """Degenerate sizes (reference sizes-list pattern: m=0, m <= mb,
+    single element) through the distributed pipeline."""
+    nb = 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=m + 70) if m else np.zeros((0, 0))
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    res = hermitian_eigensolver("L", mat, backend="pipeline")
+    assert res.eigenvalues.shape == (m,)
+    assert tuple(res.eigenvectors.size) == (m, m)
+    if m:
+        np.testing.assert_allclose(res.eigenvalues, np.linalg.eigvalsh(a), atol=1e-10)
+        check_eig(a, res.eigenvalues, res.eigenvectors.to_global())
