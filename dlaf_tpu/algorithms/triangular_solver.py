@@ -195,6 +195,74 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
     return coll.relocal(b)
 
 
+def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
+    """Bucketed variant of _trsm_right_kernel: the remaining-COLS window of
+    B (and the op(A)[k, :] panel) is dynamic-sliced with a static
+    per-segment size — the column-axis mirror of the left bucketed kernel
+    (halves the einsum flops vs the full-stack masked form)."""
+    a = coll.local(a)
+    b = coll.local(b)
+    myr, myc = coll.my_rank()
+    a = _spmd.pad_diag_identity(a, g_a, myr, myc)
+    lower = uplo == t.LOWER
+    forward = lower != (op == t.NO_TRANS)
+    nt = g_a.nt
+    b = (jnp.asarray(alpha, b.dtype) * b).astype(b.dtype)
+
+    def step(s, b, C):
+        k = s if forward else nt - 1 - s
+        kr, kc = k % g_a.pr, k % g_a.pc
+        lkc = k // g_a.pc
+        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+        bcol = _spmd.take_col(b, lkc, g_b)
+        solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
+        xc = coll.psum_axis(
+            jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
+        )
+        b = _spmd.put_col(b, jnp.where(myc == kc, solved, bcol), lkc)
+        # remaining-cols window
+        if forward:
+            cs = jnp.clip((k + g_a.pc - myc) // g_a.pc, 0, max(g_b.ltc - C, 0))
+            cs = cs.astype(jnp.asarray(k).dtype)
+        else:
+            cs = jnp.asarray(k) * 0  # start at 0, only the size shrinks
+        gj_w = (cs + jnp.arange(C)) * g_a.pc + myc
+        remaining = (gj_w > k) if forward else (gj_w < k)
+        if op == t.NO_TRANS:
+            ar = lax.dynamic_slice(
+                a, (k // g_a.pr, cs, 0, 0), (1, C, g_a.mb, g_a.mb)
+            )[0]
+            rp = coll.psum_axis(
+                jnp.where((myr == kr) & remaining[:, None, None], ar, jnp.zeros_like(ar)),
+                ROW_AXIS,
+            )
+        else:
+            ac = _spmd.take_col(a, lkc, g_a)  # tiles A[i, k] for local rows i
+            gi = _spmd.local_row_tiles(g_a, myr)
+            rem_i = (gi > k) if forward else (gi < k)
+            cp = coll.psum_axis(
+                jnp.where((myc == kc) & rem_i[:, None, None], ac, jnp.zeros_like(ac)),
+                COL_AXIS,
+            )
+            # col panel -> windowed row panel: tiles indexed by A's row j
+            src_slot = jnp.clip(gj_w // g_a.pr, 0, g_a.ltr - 1)
+            have = (gj_w % g_a.pr == myr) & (gj_w < g_a.nt)
+            contrib = jnp.where(
+                have[:, None, None], jnp.take(cp, src_slot, axis=0), 0
+            )
+            rp = t.op_tile(coll.psum_axis(contrib, ROW_AXIS), op)
+            rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
+        bs = lax.dynamic_slice(b, (0, cs, 0, 0), (g_b.ltr, C, g_b.mb, g_b.nb))
+        bs = bs - jnp.einsum("iab,jbc->ijac", xc, rp)
+        return lax.dynamic_update_slice(b, bs, (0, cs, 0, 0))
+
+    for s0, s1 in _spmd.halving_segments(nt):
+        rem = nt - 1 - s0  # max remaining tiles within the segment
+        C = max(min(g_b.ltc, (rem + g_a.pc - 1) // g_a.pc + 1), 1)
+        b = lax.fori_loop(s0, s1, partial(step, C=C), b)
+    return coll.relocal(b)
+
+
 def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
     """Lookahead variant of _trsm_left_kernel (reference: the next-panel
     high-priority tasks of solver/triangular/impl.h): each iteration writes
@@ -359,11 +427,15 @@ def triangular_solver(
     if side == t.LEFT:
         kern_fn = _trsm_left_lookahead_kernel if lookahead else _trsm_left_bucketed_kernel
     else:
-        kern_fn = _trsm_right_kernel
+        kern_fn = _trsm_right_bucketed_kernel
     from dlaf_tpu.tune import blas3_precision
 
-    # only the left bucketed kernel bakes ratio-dependent segments
-    ratio = _spmd.bucket_ratio() if kern_fn is _trsm_left_bucketed_kernel else None
+    # only the bucketed kernels bake ratio-dependent segments
+    ratio = (
+        _spmd.bucket_ratio()
+        if kern_fn in (_trsm_left_bucketed_kernel, _trsm_right_bucketed_kernel)
+        else None
+    )
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b,
            lookahead, ratio)
     if key not in _cache:
