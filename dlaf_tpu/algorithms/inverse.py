@@ -73,6 +73,108 @@ def _trtri_lower_kernel(x, g: _spmd.Geometry, diag):
     return coll.relocal(x)
 
 
+def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
+    """Bucketed variant of _trtri_lower_kernel: the trailing-inverse slab
+    {i >= k+1} x {j >= k+1} is dynamic-sliced with static per-segment
+    sizes.  The loop runs BACKWARD (k = mt-1 .. 0), so windows GROW with
+    the step index — segments size their bucket for the segment's LAST
+    step."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    eye = jnp.eye(g.mb, dtype=x.dtype)
+    mt = g.mt
+
+    def step(s, x, L, C):
+        k = mt - 1 - s
+        kr, kc = k % g.pr, k % g.pc
+        lkr, lkc = k // g.pr, k // g.pc
+        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        tkk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, diag, 1.0, akk, eye)
+        # window of rows/cols >= k+1
+        rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
+        cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
+        gi_w = (rs + jnp.arange(L)) * g.pr + myr
+        gj_w = (cs + jnp.arange(C)) * g.pc + myc
+        below = (gi_w > k)[:, None, None]
+        # original column k below the diagonal, to every rank column
+        xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+        cp = coll.psum_axis(
+            jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
+        )
+        rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k], j window
+        # S[i] = sum_j inv[i,j] L[j,k] over the trailing slab (inv final there)
+        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+        keep = ((gj_w > k)[None, :] & (gi_w[:, None] >= gj_w[None, :]))[:, :, None, None]
+        s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
+        s_full = coll.psum_axis(s_part, COL_AXIS)
+        newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
+        newcol = jnp.where(below & (myc == kc), newcol, xc)
+        x = lax.dynamic_update_slice(x, newcol[:, None], (rs, lkc, 0, 0))
+        # diagonal tile write (outside the window)
+        mine_d = (myr == kr) & (myc == kc)
+        dtile = jnp.where(mine_d, tkk, x[lkr, lkc])[None, None]
+        return lax.dynamic_update_slice(x, dtile.astype(x.dtype), (lkr, lkc, 0, 0))
+
+    for s0, s1 in _spmd.halving_segments(mt):
+        # backward loop: largest window inside the segment is at its LAST
+        # step s1-1 (k = mt - s1, trailing extent s1 - 1 tiles... + 1 slack)
+        rem = s1 - 1
+        L = max(min(g.ltr, (rem + g.pr - 1) // g.pr + 1), 1)
+        C = max(min(g.ltc, (rem + g.pc - 1) // g.pc + 1), 1)
+        x = lax.fori_loop(s0, s1, partial(step, L=L, C=C), x)
+
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
+def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
+    """Row-wise mirror of _trtri_lower_bucketed_kernel (upper triangle)."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    eye = jnp.eye(g.mb, dtype=x.dtype)
+    mt = g.mt
+
+    def step(s, x, L, C):
+        k = mt - 1 - s
+        kr, kc = k % g.pr, k % g.pc
+        lkr, lkc = k // g.pr, k // g.pc
+        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        tkk = t.trsm(t.LEFT, t.UPPER, t.NO_TRANS, diag, 1.0, akk, eye)
+        rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
+        cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
+        gi_w = (rs + jnp.arange(L)) * g.pr + myr
+        gj_w = (cs + jnp.arange(C)) * g.pc + myc
+        right = (gj_w > k)[:, None, None]
+        # windowed row panel of U[k, cs:cs+C] (covers all trailing cols > k)
+        xr = lax.dynamic_slice(x, (lkr, cs, 0, 0), (1, C, g.mb, g.mb))[0]
+        rp = coll.psum_axis(
+            jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
+        )
+        # row panel U[k, v] -> windowed col panel indexed by window rows i
+        cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
+        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+        keep = ((gi_w > k)[:, None] & (gi_w[:, None] <= gj_w[None, :]))[:, :, None, None]
+        s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
+        s_full = coll.psum_axis(s_part, ROW_AXIS)
+        newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
+        newrow = jnp.where(right & (myr == kr), newrow, xr)
+        x = lax.dynamic_update_slice(x, newrow[None, :], (lkr, cs, 0, 0))
+        mine_d = (myr == kr) & (myc == kc)
+        dtile = jnp.where(mine_d, tkk, x[lkr, lkc])[None, None]
+        return lax.dynamic_update_slice(x, dtile.astype(x.dtype), (lkr, lkc, 0, 0))
+
+    for s0, s1 in _spmd.halving_segments(mt):
+        rem = s1 - 1
+        L = max(min(g.ltr, (rem + g.pr - 1) // g.pr + 1), 1)
+        C = max(min(g.ltc, (rem + g.pc - 1) // g.pc + 1), 1)
+        x = lax.fori_loop(s0, s1, partial(step, L=L, C=C), x)
+
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x)
+
+
 def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
     x = coll.local(x)
     myr, myc = coll.my_rank()
@@ -155,9 +257,13 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         return _trtri_single_device(uplo, diag, mat_a)
     from dlaf_tpu.tune import blas3_precision
 
-    key = (mat_a.grid.cache_key, uplo, diag, g)
+    # bucketed kernels bake ratio-dependent trailing windows at trace time
+    ratio = _spmd.bucket_ratio()
+    key = (mat_a.grid.cache_key, uplo, diag, g, ratio)
     if key not in _cache:
-        kern_fn = _trtri_lower_kernel if uplo == t.LOWER else _trtri_upper_kernel
+        kern_fn = (
+            _trtri_lower_bucketed_kernel if uplo == t.LOWER else _trtri_upper_bucketed_kernel
+        )
         _cache[key] = coll.spmd(
             mat_a.grid, partial(kern_fn, g=g, diag=diag), donate_argnums=(0,)
         )

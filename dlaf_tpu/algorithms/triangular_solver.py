@@ -175,14 +175,7 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
                 ROW_AXIS,
             )
             # row panel -> windowed col panel: tiles indexed by A's col j
-            iv = gi_w
-            pc = g_a.pc
-            src_slot = jnp.clip(iv // pc, 0, g_a.ltc - 1)
-            have = (iv % pc == myc) & (iv < g_a.mt)
-            contrib = jnp.where(
-                have[:, None, None], jnp.take(rp, src_slot, axis=0), 0
-            )
-            cp = t.op_tile(coll.psum_axis(contrib, COL_AXIS), op)
+            cp = t.op_tile(coll.transpose_panel_rows_windowed(rp, gi_w, 0, g_a.mt), op)
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
         bs = lax.dynamic_slice(b, (rs, 0, 0, 0), (L, g_b.ltc, g_b.mb, g_b.nb))
         bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
@@ -245,12 +238,7 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
                 COL_AXIS,
             )
             # col panel -> windowed row panel: tiles indexed by A's row j
-            src_slot = jnp.clip(gj_w // g_a.pr, 0, g_a.ltr - 1)
-            have = (gj_w % g_a.pr == myr) & (gj_w < g_a.nt)
-            contrib = jnp.where(
-                have[:, None, None], jnp.take(cp, src_slot, axis=0), 0
-            )
-            rp = t.op_tile(coll.psum_axis(contrib, ROW_AXIS), op)
+            rp = t.op_tile(coll.transpose_panel_windowed(cp, gj_w, 0, g_a.nt), op)
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
         bs = lax.dynamic_slice(b, (0, cs, 0, 0), (g_b.ltr, C, g_b.mb, g_b.nb))
         bs = bs - jnp.einsum("iab,jbc->ijac", xc, rp)

@@ -124,6 +124,25 @@ def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
     return lax.psum(contrib, ROW_AXIS)
 
 
+def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
+    """Windowed mirror of :func:`transpose_panel_windowed` (row panel ->
+    column panel): ``rp[C, ...]`` holds panel tiles for this rank's local
+    col slots ``cs .. cs+C-1`` (global tiles ``(cs+j)*Pc + myc``); returns
+    ``cp[W, ...]`` with ``cp[w] = panel tile of global index iv[w]`` (zero
+    where out of range).  ``cs`` may differ per rank column (each
+    contributor uses its own window offset); pass ``cs=0`` with a full
+    ``C=ltc`` panel for the unwindowed-source case."""
+    _, myc = my_rank()
+    _, pc = grid_shape()
+    C = rp.shape[0]
+    W = iv.shape[0]
+    src_slot = iv // pc - cs
+    have = (iv % pc == myc) & (iv < nr_col_tiles) & (src_slot >= 0) & (src_slot < C)
+    taken = jnp.take(rp, jnp.clip(src_slot, 0, C - 1), axis=0)
+    contrib = jnp.where(have.reshape((W,) + (1,) * (rp.ndim - 1)), taken, 0)
+    return lax.psum(contrib, COL_AXIS)
+
+
 def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
     """Row panel -> column panel redistribution (inverse of
     :func:`transpose_panel`).

@@ -170,7 +170,7 @@ def reject_input_file(args, driver: str) -> None:
 
 
 def make_grid(args) -> Grid:
-    if np.dtype(DTYPES[args.type]).itemsize == 8:
+    if np.dtype(DTYPES[args.type]).itemsize >= 8:  # d (f64) and z (c128)
         jax.config.update("jax_enable_x64", True)
     return Grid.create(Size2D(args.grid_rows, args.grid_cols))
 

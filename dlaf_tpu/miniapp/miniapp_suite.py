@@ -48,12 +48,14 @@ def main(argv=None):
     def dm(a):
         return lambda: DistributedMatrix.from_global(grid, a, (mb, mb))
 
+    check = None
     if name == "trmm":
         from dlaf_tpu.algorithms.multiplication import triangular_multiplication
 
         mat_a = dm(tri)()
         run = lambda b: triangular_multiplication(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, b)
         make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a) / 2, _n3(a) / 2)
+        check = lambda out: tu.assert_near(out, tri @ dense, tu.tol_for(dtype, m, 200.0))
     elif name == "hemm":
         from dlaf_tpu.algorithms.multiplication import hermitian_multiplication
 
@@ -61,12 +63,19 @@ def main(argv=None):
         zero = dm(np.zeros((m, m), dtype))()
         run = lambda b: hermitian_multiplication(t.LEFT, t.LOWER, 1.0, mat_a, b, 0.0, zero)
         make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a), _n3(a))
+        check = lambda out: tu.assert_near(out, herm @ dense, tu.tol_for(dtype, m, 200.0))
     elif name == "gen_to_std":
         from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
 
-        mat_b = dm(np.linalg.cholesky(tu.random_hermitian_pd(m, dtype, seed=4)))()
+        b_l = np.linalg.cholesky(tu.random_hermitian_pd(m, dtype, seed=4))
+        mat_b = dm(b_l)()
         run = lambda a: generalized_to_standard("L", a, mat_b)
         make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, _n3(a) / 2, _n3(a) / 2)
+
+        def check(out):
+            # inv(Lb) @ A @ inv(Lb)^H, compared on the stored lower triangle
+            expected = np.linalg.solve(b_l, np.linalg.solve(b_l, herm).conj().T).conj().T
+            tu.assert_near(out, expected, tu.tol_for(dtype, m, 500.0), uplo="L")
     elif name == "red2band":
         from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
 
@@ -88,22 +97,40 @@ def main(argv=None):
 
         rng = np.random.default_rng(0)
         d_, e_ = rng.standard_normal(m), rng.standard_normal(m - 1)
+        last_w = []
 
         def run(a):
-            _, v = tridiagonal_eigensolver(grid, d_, e_, mb, dtype=dtype)
+            w, v = tridiagonal_eigensolver(grid, d_, e_, mb, dtype=dtype)
+            last_w[:] = [np.asarray(w)]
             return v
 
         make, fl = dm(np.zeros((m, m), dtype)), None
+
+        def check(out):
+            v = np.asarray(out.to_global())
+            w = last_w[0]
+            tmat = np.diag(d_) + np.diag(e_, 1) + np.diag(e_, -1)
+            resid = np.abs(tmat @ v - v * w[None, :]).max()
+            ortho = np.abs(v.conj().T @ v - np.eye(m)).max()
+            tol = tu.tol_for(dtype, m, 500.0)
+            if resid > tol or ortho > tol:
+                raise AssertionError(f"tridiag check: resid={resid} ortho={ortho} tol={tol}")
     elif name == "trtri":
         from dlaf_tpu.algorithms.inverse import triangular_inverse
 
         run = lambda a: triangular_inverse("L", "N", a)
         make, fl = dm(tri), lambda a: common.ops_add_mul(dtype, _n3(a) / 6, _n3(a) / 6)
+        check = lambda out: tu.assert_near(
+            out, np.linalg.inv(tri), tu.tol_for(dtype, m, 500.0), uplo="L"
+        )
     elif name == "potri":
         from dlaf_tpu.algorithms.inverse import inverse_from_cholesky_factor
 
         run = lambda a: inverse_from_cholesky_factor("L", a)
         make, fl = dm(np.linalg.cholesky(herm)), lambda a: common.ops_add_mul(dtype, _n3(a) / 3, _n3(a) / 3)
+        check = lambda out: tu.assert_near(
+            out, np.linalg.inv(herm), tu.tol_for(dtype, m, 1000.0)
+        )
     elif name == "bt_red2band":
         from dlaf_tpu.algorithms.bt_reduction_to_band import bt_reduction_to_band
         from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
@@ -114,21 +141,29 @@ def main(argv=None):
     elif name == "norm":
         from dlaf_tpu.algorithms.norm import max_norm
 
+        last_norm = []
+
         def run(a):
-            max_norm(a)
+            last_norm[:] = [max_norm(a)]
             return a
 
         make, fl = dm(dense), None
+
+        def check(out):
+            expected = float(np.abs(dense).max())
+            if not np.isclose(last_norm[0], expected, rtol=1e-6):
+                raise AssertionError(f"norm check: got {last_norm[0]}, want {expected}")
     elif name == "permute":
         from dlaf_tpu.algorithms.permutations import permute
 
         perm = np.random.default_rng(1).permutation(m)
         run = lambda a: permute(a, perm, "rows")
         make, fl = dm(dense), None
+        check = lambda out: tu.assert_near(out, dense[perm], tu.tol_for(dtype, m, 10.0))
     else:
         print(f"unknown miniapp {name!r}; see module docstring")
         return 1
-    return common.run_timed(args, make, run, None, fl, name=name)
+    return common.run_timed(args, make, run, check, fl, name=name)
 
 
 if __name__ == "__main__":
