@@ -41,6 +41,17 @@ from dlaf_tpu.algorithms.eigensolver import (
 )
 from dlaf_tpu.algorithms.norm import max_norm
 from dlaf_tpu.algorithms.permutations import permute
+from dlaf_tpu.algorithms.solver import (
+    MixedSolveInfo,
+    cholesky_solver,
+    positive_definite_solver,
+    positive_definite_solver_mixed,
+)
+from dlaf_tpu.algorithms.eig_refine import (
+    EigRefineInfo,
+    hermitian_eigensolver_mixed,
+    refine_eigenpairs,
+)
 
 __version__ = "0.1.0"
 
@@ -71,5 +82,12 @@ __all__ = [
     "hermitian_generalized_eigensolver",
     "max_norm",
     "permute",
+    "MixedSolveInfo",
+    "cholesky_solver",
+    "positive_definite_solver",
+    "positive_definite_solver_mixed",
+    "EigRefineInfo",
+    "hermitian_eigensolver_mixed",
+    "refine_eigenpairs",
     "__version__",
 ]

@@ -306,8 +306,10 @@ def cholesky_factorization(
         low = mutil.transpose(mutil.extract_triangle(mat_a, "U"), conj=True)
         fac = cholesky_factorization(t.LOWER, low, _dump=False)
         u = mutil.transpose(mutil.extract_triangle(fac, "L"), conj=True)
-        # keep the caller's original lower triangle untouched (LAPACK-style)
-        return mat_a.like(
+        # keep the caller's original lower triangle untouched (LAPACK-style);
+        # _inplace (not like): the docstring promises in-place semantics, and
+        # the L path repoints the caller's handle — U must match
+        return mat_a._inplace(
             mutil.extract_triangle(mat_a, "L", k=-1).data + mutil.extract_triangle(u, "U").data
         )
     raise ValueError(f"bad uplo {uplo}")

@@ -28,7 +28,13 @@ def _max_norm_data(x, dist, uplo):
     elif uplo == "U":
         keep &= gi <= gj
     vals = jnp.where(keep, jnp.abs(x), 0)
-    return jnp.max(vals) if x.size else jnp.zeros((), vals.dtype)
+    if not x.size:
+        return jnp.zeros((), vals.dtype)
+    # NaN must survive the reduction: the cross-shard max collective is not
+    # guaranteed to propagate it (observed dropping NaN on the CPU mesh), so
+    # detect it with an or-reduce of isnan, which has no NaN semantics
+    bad = jnp.any(jnp.isnan(vals))
+    return jnp.where(bad, jnp.asarray(jnp.nan, vals.dtype), jnp.max(vals))
 
 
 def max_norm(mat: DistributedMatrix, uplo: str = "G") -> float:

@@ -1,0 +1,146 @@
+"""Positive-definite linear solvers: POTRS / POSV drivers and
+mixed-precision iterative refinement (the LAPACK DSPOSV/ZCPOSV family,
+re-designed TPU-first).
+
+The reference stops at the building blocks — Cholesky factorization
+(factorization/cholesky.h:72) and the triangular solver
+(solver/triangular.h:47) — and its users compose them into the ScaLAPACK
+calls they actually need (p?potrs / p?posv).  ``cholesky_solver`` /
+``positive_definite_solver`` are those compositions over the distributed
+kernels here.
+
+``positive_definite_solver_mixed`` is the TPU-native extra: TPU MXUs have
+no native f64 pipeline, so the classical refinement scheme of LAPACK
+dsposv (factor in low precision, refine with high-precision residuals —
+Langou et al., "Exploiting the performance of 32 bit floating point
+arithmetic in obtaining 64 bit accuracy", SC'06) maps exactly onto the
+hardware: the O(N^3) factorization and the per-iteration O(N^2 k)
+triangular solves run in f32 (fast bf16 MXU passes), and only the O(N^2 k)
+residual GEMMs pay the emulated-f64 cost.  Same convergence criterion as
+LAPACK dsposv: ||r||_max <= ||x||_max * ||A||_max * sqrt(N) * eps(target),
+at most ``max_iters`` refinement sweeps, with an optional full-precision
+fallback when refinement stalls (dsposv's ITER<0 path).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.multiplication import hermitian_multiplication
+from dlaf_tpu.algorithms.norm import max_norm
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+def cholesky_solver(
+    uplo: str, mat_l: DistributedMatrix, mat_b: DistributedMatrix
+) -> DistributedMatrix:
+    """POTRS: solve A X = B given the Cholesky factor of A in the ``uplo``
+    triangle of ``mat_l`` (as produced by ``cholesky_factorization``).
+    Returns the updated B (functional in-place, like the trsm it wraps)."""
+    if uplo == t.LOWER:
+        y = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_l, mat_b)
+        return triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, mat_l, y)
+    y = triangular_solver(t.LEFT, t.UPPER, t.CONJ_TRANS, t.NON_UNIT, 1.0, mat_l, mat_b)
+    return triangular_solver(t.LEFT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_l, y)
+
+
+def positive_definite_solver(
+    uplo: str, mat_a: DistributedMatrix, mat_b: DistributedMatrix
+) -> DistributedMatrix:
+    """POSV: factor the Hermitian positive-definite ``mat_a`` (in place —
+    its ``uplo`` triangle holds the Cholesky factor on return) and solve
+    A X = B.  Returns the updated B."""
+    fac = cholesky_factorization(uplo, mat_a)
+    return cholesky_solver(uplo, fac, mat_b)
+
+
+@dataclass
+class MixedSolveInfo:
+    iters: int  # refinement sweeps performed (0 = first solve was enough)
+    converged: bool  # met the dsposv criterion in <= max_iters sweeps
+    fallback: bool  # full-precision factorization was used instead
+    backward_error: float  # final ||r||_max / (||x||_max * ||A||_max)
+
+
+def _lower_dtype(dtype, factor_dtype):
+    dt = np.dtype(dtype)
+    if factor_dtype is not None:
+        return np.dtype(factor_dtype)
+    if dt == np.complex128:
+        return np.dtype(np.complex64)
+    if dt == np.float64:
+        return np.dtype(np.float32)
+    raise ValueError(
+        f"positive_definite_solver_mixed: no default low precision below "
+        f"{dt.name}; pass factor_dtype explicitly"
+    )
+
+
+def positive_definite_solver_mixed(
+    uplo: str,
+    mat_a: DistributedMatrix,
+    mat_b: DistributedMatrix,
+    factor_dtype=None,
+    max_iters: int = 30,
+    fallback: bool = True,
+) -> tuple[DistributedMatrix, MixedSolveInfo]:
+    """Solve A X = B to ``mat_a.dtype`` accuracy from a LOW-precision
+    Cholesky factorization plus iterative refinement (LAPACK dsposv/zcposv
+    analogue).  ``mat_a`` must be f64/c128 (or pass ``factor_dtype``); it
+    is NOT modified — the factorization happens on a cast copy.
+
+    Returns ``(x, info)``: a NEW matrix with the solution (``mat_b`` is
+    not modified either) and a :class:`MixedSolveInfo`.  If refinement has
+    not met the dsposv criterion after ``max_iters`` sweeps and
+    ``fallback=True``, the system is re-solved with a full-precision
+    factorization (dsposv's ITER<0 path); with ``fallback=False`` the best
+    iterate is returned with ``converged=False``."""
+    target = np.dtype(mat_a.dtype)
+    low = _lower_dtype(target, factor_dtype)
+    n = mat_a.size.rows
+    if n == 0 or mat_b.size.cols == 0:
+        return mat_b.like(mat_b.data), MixedSolveInfo(0, True, False, 0.0)
+    eps = np.finfo(np.dtype(target).type(0).real.dtype).eps
+    anorm = max_norm(mat_a, uplo)
+    tol = float(anorm) * np.sqrt(n) * eps
+
+    fac_lo = cholesky_factorization(uplo, mat_a.astype(low), _dump=False)
+    x = cholesky_solver(uplo, fac_lo, mat_b.astype(low)).astype(target)
+
+    info = MixedSolveInfo(0, False, False, np.inf)
+    for it in range(max_iters + 1):
+        # r = B - A x in TARGET precision (only the uplo triangle of A is
+        # stored; hermitian_multiplication reads it as the full matrix);
+        # astype = fresh-buffer copy, safe for the donating update
+        r = hermitian_multiplication(t.LEFT, uplo, -1.0, mat_a, x, 1.0, mat_b.astype(target))
+        rnorm = max_norm(r)
+        xnorm = max_norm(x)
+        info.iters = it
+        info.backward_error = rnorm / (xnorm * float(anorm)) if xnorm else 0.0
+        if rnorm <= xnorm * tol:
+            info.converged = True
+            return x, info
+        if it == max_iters or not (np.isfinite(rnorm) and np.isfinite(xnorm)):
+            # NaN/inf iterate: the low-precision factorization failed (e.g.
+            # A indefinite at eps(low)); refinement cannot recover — bail to
+            # the fallback immediately
+            break
+        d = cholesky_solver(uplo, fac_lo, r.astype(low))
+        x = x.like(x.data + d.data.astype(target))
+
+    if not fallback:
+        return x, info
+    # refinement stalled (ill-conditioned beyond 1/eps(low)): full-precision
+    # factorization, like dsposv's negative-ITER exit into dpotrf/dpotrs
+    info.fallback = True
+    fac = cholesky_factorization(uplo, mat_a.astype(target), _dump=False)
+    x = cholesky_solver(uplo, fac, mat_b.astype(target))
+    r = hermitian_multiplication(t.LEFT, uplo, -1.0, mat_a, x, 1.0, mat_b.astype(target))
+    rnorm, xnorm = max_norm(r), max_norm(x)
+    info.backward_error = rnorm / (xnorm * float(anorm)) if xnorm else 0.0
+    info.converged = rnorm <= xnorm * tol
+    return x, info

@@ -139,6 +139,15 @@ class DistributedMatrix:
     def like(self, data: Optional[jax.Array] = None) -> "DistributedMatrix":
         return DistributedMatrix(self.dist, self.grid, self.data if data is None else data)
 
+    def astype(self, dtype) -> "DistributedMatrix":
+        """Copy with the data cast to ``dtype`` (same distribution/grid).
+        Always a fresh buffer (even for a same-dtype cast) — safe to hand
+        to donating algorithms."""
+        dt = np.dtype(dtype)
+        if dt == np.dtype(self.dtype):
+            return self.like(jnp.copy(self.data))
+        return self.like(self.data.astype(dt))
+
     def _inplace(self, data: jax.Array) -> "DistributedMatrix":
         """In-place result semantics for algorithms that donate this matrix's
         buffer (reference algorithms mutate their input Matrix): repoint this

@@ -5,7 +5,7 @@ permutations}.cpp — compacted into a single driver module here).
 
 Usage: python -m dlaf_tpu.miniapp.miniapp_suite <name> [miniapp options]
 where <name> in {trmm, hemm, gen_to_std, red2band, band2trid, tridiag,
-trtri, potri, norm, permute, bt_red2band}.
+trtri, potri, posv, posv_mixed, heev_mixed, norm, permute, bt_red2band}.
 """
 from __future__ import annotations
 
@@ -138,6 +138,53 @@ def main(argv=None):
         band, taus = reduction_to_band(dm(np.tril(herm))())
         run = lambda e: bt_reduction_to_band(e, band, taus)
         make, fl = dm(dense), lambda a: common.ops_add_mul(dtype, _n3(a), _n3(a))
+    elif name == "heev_mixed":
+        from dlaf_tpu.algorithms.eig_refine import hermitian_eigensolver_mixed
+
+        if np.dtype(dtype) not in (np.dtype(np.float64), np.dtype(np.complex128)):
+            raise SystemExit("heev_mixed needs --type d or z (refines to f64/c128)")
+        last = []
+
+        def run(a):
+            res, info = hermitian_eigensolver_mixed("L", a)
+            last[:] = [(res.eigenvalues, info)]
+            return res.eigenvectors
+
+        make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, 4 * _n3(a) / 3, 4 * _n3(a) / 3)
+
+        def check(out):
+            w, info = last[0]
+            if not info.converged:
+                raise AssertionError(f"refinement did not converge: {info}")
+            v = np.asarray(out.to_global())
+            resid = np.abs(herm @ v - v * w[None, :]).max()
+            tol = tu.tol_for(dtype, m, 200.0) * max(np.abs(w).max(), 1.0)
+            if resid > tol:
+                raise AssertionError(f"heev_mixed resid {resid} > {tol}")
+    elif name in ("posv", "posv_mixed"):
+        from dlaf_tpu.algorithms.solver import (
+            positive_definite_solver,
+            positive_definite_solver_mixed,
+        )
+
+        mixed = name == "posv_mixed"
+        if mixed and np.dtype(dtype) not in (np.dtype(np.float64), np.dtype(np.complex128)):
+            raise SystemExit("posv_mixed needs --type d or z (refines to f64/c128)")
+        mat_a0 = dm(np.tril(herm))()  # distributed once, outside the timed loop
+
+        def run(b):
+            mat_a = mat_a0.astype(dtype)  # fresh device buffer: posv donates A
+            if mixed:
+                x, _info = positive_definite_solver_mixed("L", mat_a, b)
+                return x
+            return positive_definite_solver("L", mat_a, b)
+
+        # potrf N^3/3 + two triangular solves 2 N^2 k (k = N here)
+        make = dm(dense)
+        fl = lambda a: common.ops_add_mul(dtype, _n3(a) / 6 + _n3(a), _n3(a) / 6 + _n3(a))
+        check = lambda out: tu.assert_near(
+            out, np.linalg.solve(herm, dense), tu.tol_for(dtype, m, 2000.0)
+        )
     elif name == "norm":
         from dlaf_tpu.algorithms.norm import max_norm
 

@@ -127,6 +127,32 @@ def ptrsm(
     ).to_global()
 
 
+def ppotrs(
+    ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
+    b: np.ndarray, desc_b: Descriptor,
+) -> np.ndarray:
+    """Solve A X = B from the Cholesky factor in ``a`` (p?potrs)."""
+    from dlaf_tpu.algorithms.solver import cholesky_solver
+
+    _check_same_source(desc_a, desc_b)
+    return cholesky_solver(
+        uplo, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b)
+    ).to_global()
+
+
+def pposv(
+    ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
+    b: np.ndarray, desc_b: Descriptor,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Factor + solve A X = B (p?posv).  Returns (factored A, X)."""
+    from dlaf_tpu.algorithms.solver import positive_definite_solver
+
+    _check_same_source(desc_a, desc_b)
+    mat_a = _dist(ctx, a, desc_a)
+    x = positive_definite_solver(uplo, mat_a, _dist(ctx, b, desc_b))
+    return mat_a.to_global(), x.to_global()
+
+
 def pgemm(
     ctx: int, opa: str, opb: str, alpha, a, desc_a, b, desc_b, beta, c, desc_c
 ) -> np.ndarray:

@@ -67,6 +67,19 @@ def test_pheevd(ctx):
     assert np.abs(a @ z - z * w[None, :]).max() < 1e-9
 
 
+def test_ppotrs_pposv(ctx):
+    m, n = 13, 5
+    a = tu.random_hermitian_pd(m, np.float64, seed=6)
+    b = tu.random_matrix(m, n, np.float64, seed=7)
+    da = sl.Descriptor(m, m, 4, 4)
+    db = sl.Descriptor(m, n, 4, 4)
+    fac, x = sl.pposv(ctx, "L", a, da, b, db)
+    np.testing.assert_allclose(np.tril(fac), np.linalg.cholesky(a), atol=1e-10)
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+    x2 = sl.ppotrs(ctx, "L", fac, da, b, db)
+    np.testing.assert_allclose(a @ x2, b, atol=1e-9)
+
+
 def test_ptrsm_pgemm(ctx):
     m, n = 12, 8
     a = tu.random_triangular(m, np.float64, lower=True, seed=3)

@@ -1,0 +1,102 @@
+"""POTRS / POSV drivers and mixed-precision iterative refinement
+(reference composes these from factorization + solver/triangular.h; the
+mixed driver is the LAPACK dsposv/zcposv analogue, see
+algorithms/solver.py)."""
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.solver import (
+    cholesky_solver,
+    positive_definite_solver,
+    positive_definite_solver_mixed,
+)
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def _ab(grid, m, k, mb, dtype, seed=7, cond=None):
+    if cond is None:
+        a = tu.random_hermitian_pd(m, dtype, seed=seed)
+    else:
+        # SPD with prescribed condition number: Q diag(logspace) Q^H
+        rng = np.random.default_rng(seed)
+        q, _ = np.linalg.qr(rng.standard_normal((m, m)))
+        w = np.logspace(0, -np.log10(cond), m)
+        a = (q * w) @ q.T
+        a = a.astype(dtype)
+    b = tu.random_matrix(m, k, dtype, seed=seed + 1)
+    mat_a = DistributedMatrix.from_global(grid, np.tril(a), (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid, b, (mb, mb))
+    return a, b, mat_a, mat_b
+
+
+@pytest.mark.parametrize("uplo", "LU")
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_potrs_posv(grid_2x4, uplo, dtype):
+    m, k, mb = 21, 6, 4
+    a = tu.random_hermitian_pd(m, dtype, seed=3)
+    b = tu.random_matrix(m, k, dtype, seed=4)
+    expected = np.linalg.solve(a, b)
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    mat_a = DistributedMatrix.from_global(grid_2x4, tri, (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    x = positive_definite_solver(uplo, mat_a, mat_b)
+    tu.assert_near(x, expected, tu.tol_for(dtype, m, 500.0))
+    # the factor left in mat_a solves a second rhs via cholesky_solver
+    b2 = tu.random_matrix(m, k, dtype, seed=5)
+    mat_b2 = DistributedMatrix.from_global(grid_2x4, b2, (mb, mb))
+    x2 = cholesky_solver(uplo, mat_a, mat_b2)
+    tu.assert_near(x2, np.linalg.solve(a, b2), tu.tol_for(dtype, m, 500.0))
+
+
+@pytest.mark.parametrize("dtype", [np.float64], ids=str)
+def test_posv_grids_sizes(comm_grids, dtype):
+    for m, k, mb in [(3, 2, 4), (16, 4, 4), (21, 5, 5)]:
+        a = tu.random_hermitian_pd(m, dtype, seed=m)
+        b = tu.random_matrix(m, k, dtype, seed=m + 1)
+        expected = np.linalg.solve(a, b)
+        for grid in comm_grids[:3]:
+            mat_a = DistributedMatrix.from_global(grid, np.tril(a), (mb, mb))
+            mat_b = DistributedMatrix.from_global(grid, b, (mb, mb))
+            x = positive_definite_solver("L", mat_a, mat_b)
+            tu.assert_near(x, expected, tu.tol_for(dtype, m, 500.0))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+def test_posv_mixed_converges(grid_2x4, dtype):
+    """Well-conditioned system: the f32/c64 factorization + refinement must
+    reach f64-class accuracy without the full-precision fallback, and must
+    leave A and B untouched."""
+    m, k, mb = 64, 3, 8
+    a, b, mat_a, mat_b = _ab(grid_2x4, m, k, mb, dtype, seed=11)
+    a_before, b_before = mat_a.to_global().copy(), mat_b.to_global().copy()
+    x, info = positive_definite_solver_mixed("L", mat_a, mat_b)
+    assert info.converged and not info.fallback
+    assert info.iters <= 10
+    # f64-class accuracy, far beyond what the f32 factor alone delivers
+    tu.assert_near(x, np.linalg.solve(a, b), tu.tol_for(dtype, m, 2000.0))
+    assert info.backward_error < 1e-12
+    np.testing.assert_array_equal(mat_a.to_global(), a_before)
+    np.testing.assert_array_equal(mat_b.to_global(), b_before)
+
+
+def test_posv_mixed_fallback(grid_2x4):
+    """cond(A) >> 1/eps(f32): refinement can't converge from the f32 factor;
+    the driver must fall back to a full-precision factorization (dsposv
+    ITER<0 path) and still return an accurate solution."""
+    m, k, mb = 48, 2, 8
+    a, b, mat_a, mat_b = _ab(grid_2x4, m, k, mb, np.float64, seed=13, cond=1e11)
+    x, info = positive_definite_solver_mixed("L", mat_a, mat_b, max_iters=4)
+    assert info.fallback
+    resid = np.abs(a @ x.to_global() - b).max()
+    assert resid <= 1e-11 * np.abs(a).max() * max(np.abs(x.to_global()).max(), 1)
+
+
+def test_posv_mixed_no_fallback_reports(grid_2x4):
+    m, k, mb = 48, 2, 8
+    a, b, mat_a, mat_b = _ab(grid_2x4, m, k, mb, np.float64, seed=13, cond=1e11)
+    x, info = positive_definite_solver_mixed(
+        "L", mat_a, mat_b, max_iters=4, fallback=False
+    )
+    assert not info.converged and not info.fallback
