@@ -63,6 +63,16 @@ for P in default high float32; do
     > "$OUT/05_potrf_prec_$P.txt" 2>&1
 done
 
+#    (d) mixed precision: the TPU-first claim (f32 MXU factor + refinement
+#        vs emulated-f64 end to end) — posv and the full eigensolver
+for APP in posv posv_mixed heev_mixed; do
+  # nruns 1: heev_mixed is a full f32 pipeline + f64 refinement sweeps —
+  # the 900s budget elsewhere covers ONE f32 eigensolve at this size
+  timeout 900 python -m dlaf_tpu.miniapp.miniapp_suite $APP \
+    --m 8192 --mb 512 --type d --nruns 1 --check last \
+    > "$OUT/05_mixed_$APP.txt" 2>&1
+done
+
 # 6. one profiler trace for the record
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \
   --type s --nruns 1 --trace "$OUT/06_trace" > "$OUT/06_trace.log" 2>&1

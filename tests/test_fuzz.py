@@ -150,3 +150,65 @@ def test_fuzz_windows(comm_grids, trial):
     mat_s = DistributedMatrix.from_global(grid, a, (nb, nb), source_rank=src)
     got2 = sub_matrix(mat_s, (r0, c0), (h, w)).to_global()
     np.testing.assert_array_equal(got2, a[r0 : r0 + h, c0 : c0 + w])
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_fuzz_posv(comm_grids, trial):
+    """Random POTRS/POSV round-trips, all dtypes/grids, k != m shapes."""
+    from dlaf_tpu.algorithms.solver import positive_definite_solver
+
+    m, nb, grid, dtype = _rand_geometry(comm_grids)
+    k = int(RNG.integers(1, 20))
+    a = tu.random_hermitian_pd(m, dtype, seed=trial + 60)
+    b = tu.random_matrix(m, k, dtype, seed=trial + 61)
+    ma = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+    mb = DistributedMatrix.from_global(grid, b, (nb, nb))
+    x = positive_definite_solver("L", ma, mb)
+    tu.assert_near(x, np.linalg.solve(a, b), tu.tol_for(dtype, m, 1000.0))
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_fuzz_posv_mixed(comm_grids, trial):
+    """Random mixed solves (f64/c128 only): must converge to target
+    accuracy on random well-conditioned SPD systems."""
+    from dlaf_tpu.algorithms.solver import positive_definite_solver_mixed
+
+    m, nb, grid, _ = _rand_geometry(comm_grids)
+    dtype = [np.float64, np.complex128][trial % 2]
+    k = int(RNG.integers(1, 10))
+    a = tu.random_hermitian_pd(m, dtype, seed=trial + 70)
+    b = tu.random_matrix(m, k, dtype, seed=trial + 71)
+    ma = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+    mb = DistributedMatrix.from_global(grid, b, (nb, nb))
+    x, info = positive_definite_solver_mixed("L", ma, mb)
+    assert info.converged
+    tu.assert_near(x, np.linalg.solve(a, b), tu.tol_for(dtype, m, 5000.0))
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_fuzz_eig_refine(comm_grids, trial):
+    """Random refinement starts: f32-grade eigenvectors of random spectra
+    (incl. planted clusters) must refine to f64-class eigenpairs."""
+    from dlaf_tpu.algorithms.eig_refine import refine_eigenpairs
+
+    nb = int(RNG.integers(2, 9))
+    m = int(RNG.integers(8, 40))
+    grid = comm_grids[int(RNG.integers(len(comm_grids)))]
+    rng = np.random.default_rng(trial + 80)
+    q, _ = np.linalg.qr(rng.standard_normal((m, m)))
+    w = np.sort(rng.standard_normal(m))
+    if trial % 2 and m > 4:  # plant a cluster
+        c0 = int(rng.integers(0, m - 3))
+        w[c0 : c0 + 3] = w[c0] + np.arange(3) * 1e-14
+        w = np.sort(w)
+    a = (q * w) @ q.T
+    a = (a + a.T) / 2
+    _w32, v32 = np.linalg.eigh(a.astype(np.float32))
+    mat = DistributedMatrix.from_global(grid, np.tril(a), (nb, nb))
+    evecs = DistributedMatrix.from_global(grid, v32.astype(np.float64), (nb, nb))
+    w_out, v, info = refine_eigenpairs("L", mat, evecs)
+    assert info.converged, info
+    vg = v.to_global()
+    assert np.abs(vg.T @ vg - np.eye(m)).max() < 1e-11
+    assert np.abs(a @ vg - vg * w_out[None, :]).max() < 1e-11 * max(np.abs(w).max(), 1)
+    np.testing.assert_allclose(w_out, w, rtol=0, atol=1e-11 * max(np.abs(w).max(), 1))
