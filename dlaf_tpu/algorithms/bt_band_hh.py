@@ -33,6 +33,22 @@ import numpy as np
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
 
+def _resolve_group_size(group_size):
+    """tune.bt_band_hh_group_size with -1 = auto: 32 on CPU backends
+    (measured 1.3-2.2x over 128 on the 8-device mesh — the larger group's
+    V windows fall out of cache), 128 on accelerators (bigger MXU GEMMs
+    per step; re-tune on hardware via scripts/tpu_day.sh)."""
+    if group_size is None:
+        from dlaf_tpu.tune import get_tune_parameters
+
+        group_size = get_tune_parameters().bt_band_hh_group_size
+    if group_size < 0:
+        import jax
+
+        group_size = 32 if jax.default_backend() == "cpu" else 128
+    return group_size
+
+
 def hh_schedule(n: int, b: int, g: int):
     """Group schedule in application order.
 
@@ -175,8 +191,7 @@ def bt_band_to_tridiagonal_hh_dist(
     dist = mat_e.dist
     n, k = dist.size
     dt = np.dtype(mat_e.dtype)
-    if group_size is None:
-        group_size = get_tune_parameters().bt_band_hh_group_size
+    group_size = _resolve_group_size(group_size)
     has_refl = v_refl.shape[0] > 0 and n > 2 and k > 0 and band > 1
     if has_refl:
         g = max(1, min(group_size, band, n - 2))
@@ -269,8 +284,7 @@ def bt_band_to_tridiagonal_hh(
         return DistributedMatrix.from_global(grid, e_host, block_size)
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
-    if group_size is None:
-        group_size = get_tune_parameters().bt_band_hh_group_size
+    group_size = _resolve_group_size(group_size)
     g = max(1, min(group_size, band, n - 2))
     groups, w = hh_schedule(n, band, g)
     V_all, tau_all, offs = _build_factors(v_refl, taus, groups, w, g, band, dt)

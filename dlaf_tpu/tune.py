@@ -36,8 +36,13 @@ class TuneParameters:
     - ``eigensolver_min_band``: lower bound used by get_band_size to pick
       the eigensolver band (smallest divisor of nb >= this; reference
       tune.h:126, get_band_size.h:20) — e.g. nb=256 yields band=128.
-    - ``bt_apply_group_size``: panels applied per back-transform fori_loop
-      step (reference bt_band_to_tridiag_hh_apply_group_size, tune.h:105).
+    - ``bt_band_hh_group_size``: reflector sweeps fused per compact-WY group
+      in the band back-transform (reference
+      bt_band_to_tridiag_hh_apply_group_size, tune.h:105).  -1 (default) =
+      auto: 32 on CPU backends (measured 2.2x the old 128 at N=2048, 1.3x
+      at N=4096 — group windows exceed cache; docs/BENCHMARKS.md), 128 on
+      accelerators (bigger MXU GEMMs per step; re-tune on hardware via
+      scripts/tpu_day.sh).
     - ``tridiag_host_solver``: 'stemr' (MRRR) or 'stedc'-style host driver
       for the tridiagonal stage.
     - ``dc_leaf_size``: target leaf-block size for the distributed D&C
@@ -95,9 +100,8 @@ class TuneParameters:
     default_block_size: int = field(default_factory=lambda: _env("default_block_size", 256, int))
     eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
     eigensolver_sbr_band: int = field(default_factory=lambda: _env("eigensolver_sbr_band", -1, int))
-    bt_apply_group_size: int = field(default_factory=lambda: _env("bt_apply_group_size", 1, int))
     bt_band_hh_group_size: int = field(
-        default_factory=lambda: _env("bt_band_hh_group_size", 128, int)
+        default_factory=lambda: _env("bt_band_hh_group_size", -1, int)
     )
     tridiag_host_solver: str = field(default_factory=lambda: _env("tridiag_host_solver", "stemr", str))
     dc_leaf_size: int = field(default_factory=lambda: _env("dc_leaf_size", 512, int))
