@@ -136,6 +136,45 @@ def c_trtri(uplo: str, diag: str, addr: int, desc, dtype_str: str) -> int:
         return 1
 
 
+def c_potrs(uplo, a_addr, desca, b_addr, descb, dtype_str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import ppotrs
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        x = ppotrs(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+        )
+        b[:, :] = x
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
+def c_posv(uplo, a_addr, desca, b_addr, descb, dtype_str) -> int:
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import pposv
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        fac, x = pposv(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+        )
+        _write_triangle(a, fac, uplo)
+        b[:, :] = x
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
 def c_trsm(side, uplo, trans, diag, are, aim, a_addr, desca, b_addr, descb, dtype_str) -> int:
     try:
         dtype = np.dtype(dtype_str)

@@ -76,6 +76,21 @@ int dlaf_pdtrtri(char uplo, char diag, double* a, const int desca[9]);
 int dlaf_pctrtri(char uplo, char diag, dlaf_complex_c* a, const int desca[9]);
 int dlaf_pztrtri(char uplo, char diag, dlaf_complex_z* a, const int desca[9]);
 
+/* ---- Positive-definite solve from the Cholesky factor (p?potrs): a
+ * holds the factor, b is overwritten with X = A^-1 B.  (No reference
+ * counterpart — composes its cholesky + triangular solver.) ---- */
+int dlaf_pspotrs(char uplo, float* a, const int desca[9], float* b, const int descb[9]);
+int dlaf_pdpotrs(char uplo, double* a, const int desca[9], double* b, const int descb[9]);
+int dlaf_pcpotrs(char uplo, dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b, const int descb[9]);
+int dlaf_pzpotrs(char uplo, dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b, const int descb[9]);
+
+/* ---- Factor + solve (p?posv): a's uplo triangle holds the Cholesky
+ * factor on exit, b is overwritten with X. ---- */
+int dlaf_psposv(char uplo, float* a, const int desca[9], float* b, const int descb[9]);
+int dlaf_pdposv(char uplo, double* a, const int desca[9], double* b, const int descb[9]);
+int dlaf_pcposv(char uplo, dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b, const int descb[9]);
+int dlaf_pzposv(char uplo, dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b, const int descb[9]);
+
 /* ---- Triangular solve: op(A) X = alpha B (side 'L') or X op(A) =
  * alpha B (side 'R'); B is overwritten with X.  trans 'N'/'T'/'C'. ---- */
 int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,

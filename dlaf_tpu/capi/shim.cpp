@@ -106,6 +106,20 @@ static int run_trsm(char side, char uplo, char trans, char diag, double are,
   return info;
 }
 
+/* two-matrix solve: potrs (a read) / posv (a factored in place) */
+static int run_solve(const char* fn, char uplo, void* a, const int desca[9],
+                     void* b, const int descb[9], const char* dt) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKNs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), (unsigned long long)(uintptr_t)b, desc_tuple(descb),
+      dt);
+  int info = run_info(fn, args);
+  PyGILState_Release(st);
+  return info;
+}
+
 static int run_gemm(char transa, char transb, double are, double aim, void* a,
                     const int desca[9], void* b, const int descb[9],
                     double bre, double bim, void* c, const int descc[9],
@@ -191,6 +205,21 @@ DLAF_TRI_FAMILY(s, float, "f4")
 DLAF_TRI_FAMILY(d, double, "f8")
 DLAF_TRI_FAMILY(c, dlaf_complex_c, "c8")
 DLAF_TRI_FAMILY(z, dlaf_complex_z, "c16")
+
+#define DLAF_SOLVE_FAMILY(suffix, ctype, dtstr)                           \
+  int dlaf_p##suffix##potrs(char uplo, ctype* a, const int desca[9],      \
+                            ctype* b, const int descb[9]) {               \
+    return run_solve("c_potrs", uplo, a, desca, b, descb, dtstr);         \
+  }                                                                       \
+  int dlaf_p##suffix##posv(char uplo, ctype* a, const int desca[9],       \
+                           ctype* b, const int descb[9]) {                \
+    return run_solve("c_posv", uplo, a, desca, b, descb, dtstr);          \
+  }
+
+DLAF_SOLVE_FAMILY(s, float, "f4")
+DLAF_SOLVE_FAMILY(d, double, "f8")
+DLAF_SOLVE_FAMILY(c, dlaf_complex_c, "c8")
+DLAF_SOLVE_FAMILY(z, dlaf_complex_z, "c16")
 
 int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,
                 float* a, const int desca[9], float* b, const int descb[9]) {

@@ -133,6 +133,52 @@ def test_capi_inprocess_trsm_gemm_trtri(shim):
     lib.dlaf_free_grid(ctx)
 
 
+def test_capi_inprocess_potrs_posv(shim):
+    """p?potrs / p?posv through the ctypes branch (f64 + c128)."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    for f in ("dlaf_pdposv", "dlaf_pdpotrs", "dlaf_pzposv"):
+        getattr(lib, f).restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb, k = 12, 4, 3
+    dp = ctypes.POINTER(ctypes.c_double)
+    a = _spd(n, np.float64, seed=5)
+    b = np.random.default_rng(6).standard_normal((n, k))
+    abuf, bbuf = np.asfortranarray(a), np.asfortranarray(b)
+    rc = lib.dlaf_pdposv(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        bbuf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(a @ bbuf, b, atol=1e-9)
+    np.testing.assert_allclose(np.tril(abuf), np.linalg.cholesky(a), atol=1e-10)
+    # potrs reusing the factor posv left in abuf
+    b2 = np.random.default_rng(7).standard_normal((n, k))
+    b2buf = np.asfortranarray(b2)
+    rc = lib.dlaf_pdpotrs(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        b2buf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(a @ b2buf, b2, atol=1e-9)
+    # complex posv
+    rng = np.random.default_rng(8)
+    az = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    az = az @ az.conj().T + n * np.eye(n)
+    bz = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))
+    azbuf, bzbuf = np.asfortranarray(az), np.asfortranarray(bz)
+    rc = lib.dlaf_pzposv(
+        ctypes.c_char(b"L"),
+        azbuf.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, n, nb, nb),
+        bzbuf.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, k, nb, nb),
+    )
+    assert rc == 0
+    np.testing.assert_allclose(az @ bzbuf, bz, atol=1e-9)
+    lib.dlaf_free_grid(ctx)
+
+
 def test_capi_inprocess_partial_spectrum(shim):
     """dlaf_pdsyevd_partial_spectrum: 1-based inclusive [il, iu]
     (reference eigensolver.h:121-127 eigenvalues_index_begin/end)."""
