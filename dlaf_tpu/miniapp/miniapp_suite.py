@@ -81,17 +81,48 @@ def main(argv=None):
 
         run = lambda a: reduction_to_band(a)[0]
         make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, 2 * _n3(a) / 3, 2 * _n3(a) / 3)
+
+        if args.check != "none":
+            _wref = np.linalg.eigvalsh(
+                herm.astype(np.complex128 if np.dtype(dtype).kind == "c" else np.float64)
+            )
+            _wtol = tu.tol_for(dtype, m, 500.0) * max(np.abs(_wref).max(), 1.0)
+
+            def check(out):
+                # Q^H A Q preserves the spectrum: compare the band matrix's
+                # eigenvalues (reflector tails below the band are NOT part
+                # of the band matrix) against A's.  eigvalsh reads the lower
+                # triangle only, so no Hermitian completion needed.
+                bw = getattr(out, "band_size", mb)  # default band = tile size
+                bfull = np.tril(np.triu(np.asarray(out.to_global()), -bw), 0)
+                err = np.abs(np.linalg.eigvalsh(bfull) - _wref).max()
+                if err > _wtol:
+                    raise AssertionError(f"red2band spectrum drift {err} > {_wtol}")
     elif name == "band2trid":
         from dlaf_tpu.algorithms.band_to_tridiag import band_to_tridiagonal
         from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
 
         band, _ = reduction_to_band(dm(np.tril(herm))())
+        last_t = []
 
         def run(a):
-            band_to_tridiagonal(band)
+            last_t[:] = [band_to_tridiagonal(band)]
             return band
 
         make, fl = (lambda: band), None
+
+        if args.check != "none":
+            _wref = np.linalg.eigvalsh(
+                herm.astype(np.complex128 if np.dtype(dtype).kind == "c" else np.float64)
+            )
+            _wtol = tu.tol_for(dtype, m, 500.0) * max(np.abs(_wref).max(), 1.0)
+
+            def check(out):
+                b2t = last_t[0]
+                tmat = np.diag(b2t.d) + np.diag(b2t.e, -1)  # eigvalsh reads lower
+                err = np.abs(np.linalg.eigvalsh(tmat) - _wref).max()
+                if err > _wtol:
+                    raise AssertionError(f"band2trid spectrum drift {err} > {_wtol}")
     elif name == "tridiag":
         from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
 
