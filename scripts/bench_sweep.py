@@ -34,12 +34,21 @@ ALGOS = {
         name: ["dlaf_tpu.miniapp.miniapp_suite", name]
         for name in (
             "trmm", "hemm", "gen_to_std", "red2band", "band2trid", "tridiag",
-            "trtri", "potri", "bt_red2band", "norm", "permute",
+            "trtri", "potri", "posv", "posv_mixed", "heev_mixed",
+            "bt_red2band", "norm", "permute",
         )
     },
 }
 
 _LINE = re.compile(r"^\[\d+\] \S+ ([0-9.eE+-]+)s ([0-9.eE+-]+|nan)GFlop/s")
+
+
+def effective_dtype(algo, dtype):
+    """Mixed drivers refine to f64/c128 by definition: promote within the
+    same number domain (s -> d, c -> z)."""
+    if algo.endswith("_mixed") and dtype not in ("d", "z"):
+        return "z" if dtype == "c" else "d"
+    return dtype
 
 
 def run_one(algo, n, pr, pc, mb, dtype, nruns, timeout):
@@ -86,8 +95,9 @@ def main():
     ):
         pr, pc = (int(v) for v in gs.split("x"))
         n = int(n)
+        dtype = effective_dtype(algo, args.type)
         try:
-            best, gf, r = run_one(algo, n, pr, pc, args.mb, args.type,
+            best, gf, r = run_one(algo, n, pr, pc, args.mb, dtype,
                                   args.nruns, args.timeout)
         except subprocess.TimeoutExpired:
             print(f"{algo} n={n} grid={gs}: TIMEOUT after {args.timeout}s")
@@ -99,7 +109,7 @@ def main():
         print(f"{algo} n={n} grid={gs}: {best:.4f}s {gf:.1f} GFlop/s")
         rows.append({
             "algo": algo, "n": n, "grid": gs, "ranks": pr * pc,
-            "mb": args.mb, "dtype": args.type, "time_s": best, "gflops": gf,
+            "mb": args.mb, "dtype": dtype, "time_s": best, "gflops": gf,
         })
         # write-through after EVERY config: a killed sweep keeps its rows
         with open(args.out, "w", newline="") as f:
