@@ -235,7 +235,79 @@ class _Child:
             if self.t_left() < 30:
                 self._note(f"stopping before n>{n}: {self.t_left():.0f}s left")
                 break
+        # LAST (flips x64; nothing f32 runs after): the mixed-precision A/B —
+        # f32-factor-plus-refinement posv vs emulated-f64 posv, the
+        # on-hardware number behind the round-4 mixed-precision claim
+        if self.t_left() > 180:
+            try:
+                self.rec["posv_mixed"] = self._time_posv_mixed(4096)
+                self._flush()
+            except BaseException as e:  # noqa: BLE001
+                self._note(f"posv_mixed failed: {type(e).__name__}: {e}")
+        else:
+            self._note(f"posv_mixed skipped: {self.t_left():.0f}s left")
         return 0
+
+    def _time_posv_mixed(self, n):
+        """One timed mixed solve and one timed full-f64 solve at N=n,
+        nrhs=16 (warmup run each).  Returns the comparison record."""
+        import jax
+
+        import dlaf_tpu.testing as tu
+        from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+        from dlaf_tpu.algorithms.solver import (
+            cholesky_solver,
+            positive_definite_solver_mixed,
+        )
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.common.index import Size2D
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+        from dlaf_tpu.miniapp.common import sync
+
+        jax.config.update("jax_enable_x64", True)
+        grid = Grid.create(Size2D(1, 1))
+        a = tu.random_hermitian_pd(n, np.float64, seed=3)
+        b = tu.random_matrix(n, 16, np.float64, seed=4)
+        mat_a = DistributedMatrix.from_global(grid, np.tril(a), (NB, NB))
+        mat_b = DistributedMatrix.from_global(grid, b, (NB, NB))
+        mixed_s, info = None, None
+        for i in range(2):  # warmup/compile, timed
+            sync(mat_a.data)
+            t0 = time.perf_counter()
+            x, info = positive_definite_solver_mixed("L", mat_a, mat_b)
+            sync(x.data)
+            mixed_s = time.perf_counter() - t0
+        rec = {
+            "metric": f"posv_mixed_n{n}_nb{NB}_f64_via_f32",
+            "mixed_s": round(mixed_s, 3),
+            "iters": info.iters,
+            "converged": bool(info.converged),
+            "fallback": bool(info.fallback),
+            "backward_error": float(info.backward_error),
+        }
+        # checkpoint before the risky emulated-f64 phase: a kill there must
+        # not discard the mixed number (flush-after-every-stage discipline)
+        self.rec["posv_mixed"] = rec
+        self._flush()
+        direct_s = None
+        if self.t_left() > 60:
+            for i in range(2):
+                fac = DistributedMatrix.from_global(grid, np.tril(a), (NB, NB))
+                rhs = DistributedMatrix.from_global(grid, b, (NB, NB))
+                sync(fac.data)
+                t0 = time.perf_counter()
+                fac = cholesky_factorization("L", fac, _dump=False)
+                xd = cholesky_solver("L", fac, rhs)
+                sync(xd.data)
+                dt = time.perf_counter() - t0
+                if i == 1:  # never record the warmup/compile run
+                    direct_s = dt
+                if self.t_left() < dt + 30:
+                    break
+        if direct_s is not None:
+            rec["direct_f64_s"] = round(direct_s, 3)
+            rec["speedup_vs_f64"] = round(direct_s / mixed_s, 2)
+        return rec
 
 
 # --------------------------- parent --------------------------------------
