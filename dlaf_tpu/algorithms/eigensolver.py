@@ -212,13 +212,30 @@ _eigh_cache = {}
 
 
 def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
-    """Single-device fast path: XLA eigh on the hermitized dense matrix."""
+    """Single-device fast path: XLA eigh on the hermitized dense matrix.
+    Partial spectra slice the eigenvector block ON DEVICE (the unpack ->
+    slice -> repack runs inside the same jit; no O(N^2) host round-trip)."""
     import jax
     import jax.numpy as jnp
 
+    from dlaf_tpu.common.index import Size2D
+    from dlaf_tpu.matrix.distribution import Distribution
     from dlaf_tpu.matrix import layout
 
     dist = mat_a.dist
+    n = dist.size.rows
+    sl = None
+    out_dist = dist
+    if spectrum is not None:
+        il, iu = int(spectrum[0]), int(spectrum[1])
+        if not 0 <= il <= iu < n:
+            raise ValueError(f"spectrum ({il}, {iu}) out of range for n={n}")
+        sl = (il, iu)
+        out_dist = Distribution(
+            Size2D(n, iu - il + 1), dist.block_size, dist.grid_size, dist.source_rank
+        )
+    # two jits: the expensive eigh compiles once per (dist, dtype); each
+    # spectrum slice only adds a tiny slice-and-pack executable
     key = (dist, np.dtype(mat_a.dtype))
     if key not in _eigh_cache:
 
@@ -226,20 +243,25 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
         def run(x):
             g = layout.unpad_global(layout.unpack(x, dist), dist)
             full = jnp.tril(g) + jnp.swapaxes(jnp.tril(g, -1), -1, -2).conj()
-            w, v = jnp.linalg.eigh(full)
-            return w, layout.pack(layout.pad_global(v, dist), dist)
+            return jnp.linalg.eigh(full)  # dense (w, v), on device
 
         _eigh_cache[key] = run
-    w, vdata = _eigh_cache[key](mat_a.data)
-    evecs = mat_a.like(jax.device_put(vdata, mat_a.grid.stacked_sharding()))
-    w_host = np.asarray(w)
-    if spectrum is not None:
-        il, iu = spectrum
-        w_host = w_host[il : iu + 1]
-        evecs = DistributedMatrix.from_global(
-            mat_a.grid, evecs.to_global()[:, il : iu + 1], mat_a.dist.block_size
-        )
-    return EigResult(w_host, evecs)
+    pkey = ("pack", dist, np.dtype(mat_a.dtype), sl)
+    if pkey not in _eigh_cache:
+
+        @jax.jit
+        def packrun(w, v):
+            if sl is not None:
+                w = w[sl[0] : sl[1] + 1]
+                v = v[:, sl[0] : sl[1] + 1]
+            return w, layout.pack(layout.pad_global(v, out_dist), out_dist)
+
+        _eigh_cache[pkey] = packrun
+    w, vdata = _eigh_cache[pkey](*_eigh_cache[key](mat_a.data))
+    evecs = DistributedMatrix(
+        out_dist, mat_a.grid, jax.device_put(vdata, mat_a.grid.stacked_sharding())
+    )
+    return EigResult(np.asarray(w), evecs)
 
 
 def hermitian_eigenvalues(
