@@ -20,15 +20,17 @@ def _check_eigh(a, w, v, tol):
     assert ortho <= tol, f"ortho {ortho:.3e} > {tol:.3e}"
 
 
+@pytest.mark.parametrize("uplo", "LU")
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
-def test_heev_mixed(grid_2x4, dtype):
+def test_heev_mixed(grid_2x4, uplo, dtype):
     """f32/c64 pipeline + refinement must deliver f64-class eigenpairs —
     orders beyond what the low-precision pipeline alone can."""
     m, nb = 96, 16
     a = tu.random_hermitian_pd(m, dtype, seed=21)
-    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    mat = DistributedMatrix.from_global(grid_2x4, tri, (nb, nb))
     a_before = mat.to_global().copy()
-    res, info = hermitian_eigensolver_mixed("L", mat)
+    res, info = hermitian_eigensolver_mixed(uplo, mat)
     assert info.converged, f"not converged: {info}"
     assert info.ortho_error < 1e-12
     w_ref = np.linalg.eigvalsh(a)
