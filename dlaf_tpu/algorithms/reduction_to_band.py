@@ -234,10 +234,19 @@ def get_band_size(nb: int) -> int:
     below ``eigensolver_min_band`` — nb itself when nb is already small
     (reference: eigensolver/internal/get_band_size.h:20).  A band smaller
     than the tile decouples the O(N^2 b) host bulge-chasing cost from the
-    MXU-shaped tile size."""
+    MXU-shaped tile size.
+
+    ``eigensolver_min_band`` -1 (the default) = auto: 33 (band 64 at
+    nb=256) on CPU backends — HEEV 1.12-1.13x over band 128 at N=2048/4096
+    on the 8-device mesh (the serial chase is O(N^2 b); band 32 loses it
+    back in bt_band) — and the reference's 100 (band 128) on accelerators,
+    where the SBR second stage absorbs the chase cost."""
     from dlaf_tpu.tune import get_tune_parameters
 
-    b_min = max(2, int(get_tune_parameters().eigensolver_min_band))
+    b_min = int(get_tune_parameters().eigensolver_min_band)
+    if b_min < 0:
+        b_min = 33 if jax.default_backend() == "cpu" else 100
+    b_min = max(2, b_min)
     for div in range(nb // b_min, 1, -1):
         if nb % div == 0:
             return nb // div

@@ -35,7 +35,10 @@ class TuneParameters:
       256 keeps tiles MXU-shaped (multiples of 128 preferred on TPU).
     - ``eigensolver_min_band``: lower bound used by get_band_size to pick
       the eigensolver band (smallest divisor of nb >= this; reference
-      tune.h:126, get_band_size.h:20) — e.g. nb=256 yields band=128.
+      tune.h:126, get_band_size.h:20).  -1 (default) = auto: 33 on CPU
+      backends (nb=256 -> band 64; measured HEEV 1.12-1.13x over band 128
+      on the mesh), 100 on accelerators (nb=256 -> band 128, the reference
+      default — SBR absorbs the chase cost there).
     - ``bt_band_hh_group_size``: reflector sweeps fused per compact-WY group
       in the band back-transform (reference
       bt_band_to_tridiag_hh_apply_group_size, tune.h:105).  -1 (default) =
@@ -98,7 +101,7 @@ class TuneParameters:
     """
 
     default_block_size: int = field(default_factory=lambda: _env("default_block_size", 256, int))
-    eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", 100, int))
+    eigensolver_min_band: int = field(default_factory=lambda: _env("eigensolver_min_band", -1, int))
     eigensolver_sbr_band: int = field(default_factory=lambda: _env("eigensolver_sbr_band", -1, int))
     bt_band_hh_group_size: int = field(
         default_factory=lambda: _env("bt_band_hh_group_size", -1, int)
