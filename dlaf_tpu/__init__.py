@@ -53,7 +53,7 @@ from dlaf_tpu.algorithms.eig_refine import (
     refine_eigenpairs,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "Grid",

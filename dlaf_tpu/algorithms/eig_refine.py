@@ -106,21 +106,29 @@ def _diags(data, dist):
 
 
 def _clusters(lam: np.ndarray, gap_floor: float, max_size: int):
-    """Runs of consecutive eigenvalues closer than the gap floor — the same
-    pair criterion as the `safe` mask in _refine_coeffs, so every pair the
-    elementwise formula skips lands in exactly one cluster.  Assumes lam
-    ascending (the pipeline returns it sorted).  Clusters larger than
-    ``max_size`` are dropped (orthogonality-only fallback handles them)."""
+    """Runs of eigenvalues closer than the gap floor — the same pair
+    criterion as the `safe` mask in _refine_coeffs, so every pair the
+    elementwise formula skips lands in exactly one cluster.  Runs are
+    detected on the SORTED values (an X + XE update can slightly reorder
+    near-degenerate Rayleigh quotients, and detecting on the raw array
+    would then split one tight cluster across two runs) and mapped back to
+    column positions; a cluster whose columns are non-contiguous cannot be
+    window-rotated and is skipped (R/2 fallback — same as oversize
+    clusters).  Clusters larger than ``max_size`` are dropped too."""
     out, i = [], 0
     n = lam.shape[0]
+    order = np.argsort(lam, kind="stable")
+    ls = lam[order]
     while i < n:
         j = i
-        while j + 1 < n and abs(lam[j + 1] - lam[j]) <= gap_floor * (
-            abs(lam[j + 1]) + abs(lam[j]) + 1
+        while j + 1 < n and abs(ls[j + 1] - ls[j]) <= gap_floor * (
+            abs(ls[j + 1]) + abs(ls[j]) + 1
         ):
             j += 1
         if j > i and (j - i + 1) <= max_size:
-            out.append((i, j + 1))
+            idx = np.sort(order[i : j + 1])
+            if idx[-1] - idx[0] == idx.size - 1:  # contiguous column window
+                out.append((int(idx[0]), int(idx[-1]) + 1))
         i = j + 1
     return out
 
