@@ -183,11 +183,23 @@ def bt_reduction_to_band(
     band = int(taus.shape[1])
     if n_panels == 0 or g_e.nt == 0:
         return mat_e
-    # taus replicated: stack to [Pr, Pc, n_panels, band]
-    taus_stacked = jnp.broadcast_to(
-        taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
-    )
-    taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
+    # taus replicated: stack to [Pr, Pc, n_panels, band].  Single-process
+    # keeps the all-on-device broadcast (a host round-trip here would sync
+    # on the tail of the reduction and serialize the pipeline); only the
+    # multi-process world needs the host-staged placement (device_put cannot
+    # reach other processes' devices).
+    if jax.process_count() > 1:
+        from dlaf_tpu.matrix.matrix import place
+
+        taus_stacked = place(
+            np.broadcast_to(np.asarray(taus), (g_a.pr, g_a.pc) + tuple(taus.shape)),
+            mat_e.grid.stacked_sharding(),
+        )
+    else:
+        taus_stacked = jnp.broadcast_to(
+            taus[None, None], (g_a.pr, g_a.pc) + tuple(taus.shape)
+        )
+        taus_stacked = jax.device_put(taus_stacked, mat_e.grid.stacked_sharding())
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision

@@ -164,5 +164,7 @@ def load_sharded(prefix: str, grid: Grid) -> DistributedMatrix:
             for r in range(pr)
         ]
     )
-    data = jax.device_put(jnp.asarray(blocks), grid.stacked_sharding())
+    from dlaf_tpu.matrix.matrix import place
+
+    data = place(blocks, grid.stacked_sharding())
     return DistributedMatrix(dist, grid, data)

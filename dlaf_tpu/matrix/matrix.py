@@ -31,6 +31,20 @@ from dlaf_tpu.matrix.distribution import Distribution
 _replicate_cache: dict = {}
 
 
+def place(x, sharding) -> jax.Array:
+    """Place a host array under ``sharding``, multi-process safe.
+
+    ``jax.device_put`` only reaches addressable devices; on a multi-process
+    world each process contributes its shards via
+    ``jax.make_array_from_callback`` (every process must hold the same host
+    content — the reference's per-rank element-init makes the same
+    assumption)."""
+    if jax.process_count() > 1:
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+    return jax.device_put(jnp.asarray(x), sharding)
+
+
 def _replicate_fn(grid: Grid):
     """Cached jitted identity with fully-replicated output sharding (one
     compile per mesh, not per to_global call)."""
@@ -92,8 +106,19 @@ class DistributedMatrix:
         cls, grid: Grid, size, block_size, dtype=jnp.float32, source_rank=(0, 0)
     ) -> "DistributedMatrix":
         dist = Distribution(Size2D(*size), Size2D(*block_size), grid.grid_size, Index2D(*source_rank))
-        data = jnp.zeros(cls.stacked_shape(dist), dtype=dtype)
-        data = jax.device_put(data, grid.stacked_sharding())
+        shape = cls.stacked_shape(dist)
+        sharding = grid.stacked_sharding()
+        if jax.process_count() > 1:
+            data = jax.make_array_from_callback(
+                shape,
+                sharding,
+                lambda idx: np.zeros(
+                    tuple(len(range(*s.indices(d))) for s, d in zip(idx, shape)),
+                    dtype=np.dtype(dtype),
+                ),
+            )
+        else:
+            data = jax.device_put(jnp.zeros(shape, dtype=dtype), sharding)
         return cls(dist, grid, data)
 
     @classmethod
@@ -111,12 +136,7 @@ class DistributedMatrix:
             Size2D(*a.shape), Size2D(*block_size), grid.grid_size, Index2D(*source_rank)
         )
         x = layout.pack(layout.pad_global(a, dist), dist)
-        sharding = grid.stacked_sharding()
-        if jax.process_count() > 1:
-            data = jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
-        else:
-            data = jax.device_put(jnp.asarray(x), sharding)
-        return cls(dist, grid, data)
+        return cls(dist, grid, place(x, grid.stacked_sharding()))
 
     @classmethod
     def from_element_function(

@@ -73,9 +73,18 @@ def transpose(mat: DistributedMatrix, conj: bool = False) -> DistributedMatrix:
         d.grid_size,
         (d.source_rank.col, d.source_rank.row),
     )
-    fn = jax.jit(partial(_transpose_data, dist=d, dist_t=dist_t, conj=conj))
+    if mat.data.size == 0:  # XLA overrides empty-output shardings to replicated
+        return DistributedMatrix.zeros(
+            mat.grid, dist_t.size, dist_t.block_size, mat.dtype, dist_t.source_rank
+        )
+    # out_shardings (not a post-hoc device_put): the compiled program ends in
+    # the resharding collective itself, which also works on multi-process
+    # worlds where device_put cannot reach non-addressable devices
+    fn = jax.jit(
+        partial(_transpose_data, dist=d, dist_t=dist_t, conj=conj),
+        out_shardings=mat.grid.stacked_sharding(),
+    )
     out = fn(mat.data)
-    out = jax.device_put(out, mat.grid.stacked_sharding())
     return DistributedMatrix(dist_t, mat.grid, out)
 
 
@@ -115,14 +124,17 @@ def retile(mat: DistributedMatrix, new_block_size) -> DistributedMatrix:
     from dlaf_tpu.matrix.distribution import Distribution as _D
 
     new_dist = _D(mat.size, new_block_size, mat.dist.grid_size, mat.dist.source_rank)
+    if mat.data.size == 0 or not all(DistributedMatrix.stacked_shape(new_dist)):
+        return DistributedMatrix.zeros(
+            mat.grid, new_dist.size, new_dist.block_size, mat.dtype, new_dist.source_rank
+        )
 
-    @_p(_jax.jit, static_argnums=(1, 2))
+    @_p(_jax.jit, static_argnums=(1, 2), out_shardings=mat.grid.stacked_sharding())
     def _relayout(x, d_old, d_new):
         g = layout.unpad_global(layout.unpack(x, d_old), d_old)
         return layout.pack(layout.pad_global(g, d_new), d_new)
 
     data = _relayout(mat.data, mat.dist, new_dist)
-    data = _jax.device_put(data, mat.grid.stacked_sharding())
     return DistributedMatrix(new_dist, mat.grid, data)
 
 
@@ -154,15 +166,23 @@ def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
 
         return window_extract(mat, origin, size)
     out_dist = _D(size, mat.dist.block_size, mat.dist.grid_size)
+    if mat.data.size == 0 or not all(DistributedMatrix.stacked_shape(out_dist)):
+        return DistributedMatrix.zeros(
+            mat.grid, out_dist.size, out_dist.block_size, mat.dtype
+        )
 
-    @_p(_jax.jit, static_argnums=(1, 2, 3), static_argnames=())
+    @_p(
+        _jax.jit,
+        static_argnums=(1, 2, 3),
+        static_argnames=(),
+        out_shardings=mat.grid.stacked_sharding(),
+    )
     def _slice(x, d_old, d_new, org):
         g = layout.unpad_global(layout.unpack(x, d_old), d_old)
         s = g[org[0] : org[0] + d_new.size.rows, org[1] : org[1] + d_new.size.cols]
         return layout.pack(layout.pad_global(s, d_new), d_new)
 
     data = _slice(mat.data, mat.dist, out_dist, tuple(origin))
-    data = _jax.device_put(data, mat.grid.stacked_sharding())
     return DistributedMatrix(out_dist, mat.grid, data)
 
 

@@ -24,9 +24,13 @@ CHUNK1="tests/test_aux.py tests/test_band_chase_device.py tests/test_band_reduct
 CHUNK2="tests/test_distribution.py tests/test_eigensolver.py tests/test_fuzz.py tests/test_gen_to_std.py tests/test_inverse.py"
 CHUNK3="tests/test_matrix.py tests/test_matrix_ref.py tests/test_miniapps.py tests/test_multiplication.py tests/test_reduction_to_band.py tests/test_scalapack_io.py tests/test_triangular_solver.py"
 CHUNK4="tests/test_tridiag_dc.py tests/test_tridiag_dc_dist.py tests/test_window.py"
+# chunk 5: the REAL multi-process jax.distributed tests — each test spawns
+# its own worker processes (with their own XLA flags), so keep them out of
+# the big single-process chunks
+CHUNK5="tests/test_multiprocess.py"
 
 # any test file not named above lands in chunk 4 (keeps additions covered)
-KNOWN="$CHUNK1 $CHUNK2 $CHUNK3 $CHUNK4"
+KNOWN="$CHUNK1 $CHUNK2 $CHUNK3 $CHUNK4 $CHUNK5"
 for f in tests/test_*.py; do
   case " $KNOWN " in
     *" $f "*) ;;
@@ -36,7 +40,7 @@ done
 
 rc=0
 i=0
-for chunk in "$CHUNK1" "$CHUNK2" "$CHUNK3" "$CHUNK4"; do
+for chunk in "$CHUNK1" "$CHUNK2" "$CHUNK3" "$CHUNK4" "$CHUNK5"; do
   i=$((i + 1))
   echo "=== chunk $i: $chunk"
   # shellcheck disable=SC2086

@@ -1,0 +1,103 @@
+"""Stdlib-only launcher for the multi-process jax.distributed workers.
+
+Shared by tests/test_multiprocess.py (pytest) and __graft_entry__.py's
+dryrun multi-process leg (driver environments without pytest installed) —
+keep this module free of non-stdlib imports.
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multiproc_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_world(nprocs, local_devices, case, n=32, nb=8, grid_rows=2, timeout=1200):
+    """Spawn an nprocs-process world and wait for every rank to pass."""
+    port = _free_port()
+    env = dict(os.environ)
+    # the worker sets its own platform/device-count flags pre-import
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                WORKER,
+                "--coordinator", f"127.0.0.1:{port}",
+                "--nprocs", str(nprocs),
+                "--rank", str(r),
+                "--local-devices", str(local_devices),
+                "--case", case,
+                "--n", str(n),
+                "--nb", str(nb),
+                "--grid-rows", str(grid_rows),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+        )
+        for r in range(nprocs)
+    ]
+    deadline = time.monotonic() + timeout
+    outs = [b""] * nprocs
+    # fail fast: one crashed rank leaves the others hung in a collective, so
+    # poll the world and kill it as soon as any rank exits nonzero instead
+    # of burning the whole timeout
+    why = None
+    while True:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            break
+        if any(c is not None and c != 0 for c in codes):
+            why = f"rank(s) {[r for r, c in enumerate(codes) if c]} exited nonzero"
+            break
+        if time.monotonic() > deadline:
+            why = f"timed out after {timeout}s"
+            break
+        time.sleep(0.25)
+    if why is not None:
+        time.sleep(1.0)  # grace: let healthy ranks notice the dead peer
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # drain every pipe unconditionally (also closes the fds)
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=10)
+            outs[r] += out or b""
+        except Exception:  # noqa: BLE001 - reporting path, best effort
+            pass
+    if why is not None:
+        raise AssertionError(
+            f"multiproc case={case} nprocs={nprocs} {why}\n" + _report(procs, outs)
+        )
+    bad = [
+        r
+        for r, p in enumerate(procs)
+        if p.returncode != 0 or b"MPWORKER_OK" not in outs[r]
+    ]
+    if bad:
+        raise AssertionError(
+            f"multiproc case={case} nprocs={nprocs} failed on ranks {bad}\n"
+            + _report(procs, outs)
+        )
+
+
+def _report(procs, outs) -> str:
+    parts = []
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        txt = out.decode(errors="replace")
+        tail = "\n".join(txt.splitlines()[-25:])
+        parts.append(f"--- rank {r} rc={p.returncode} ---\n{tail}")
+    return "\n".join(parts)

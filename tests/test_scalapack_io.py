@@ -58,6 +58,59 @@ def test_nonzero_source_rank(ctx):
         )
 
 
+def test_numroc():
+    """numroc against its definition on a sweep of shapes/blocks/grids."""
+    for n in (0, 1, 5, 13, 32, 37):
+        for nb in (1, 3, 4, 8):
+            for p in (1, 2, 3, 4):
+                for src in range(p):
+                    owned = [0] * p
+                    for blk in range((n + nb - 1) // nb):
+                        r = (src + blk) % p
+                        owned[r] += min(nb, n - blk * nb)
+                    for r in range(p):
+                        assert sl.numroc(n, nb, r, src, p) == owned[r], (n, nb, p, src, r)
+
+
+@pytest.mark.parametrize("isrc,jsrc", [(0, 0), (1, 2)])
+def test_local_buffer_roundtrip(grid_2x4, isrc, jsrc):
+    """Distributed-buffer mode single-process: every grid position is local,
+    so the dict carries all slabs — global -> slabs -> matrix -> slabs ->
+    global must be the identity, and ppotrf_local must match ppotrf."""
+    m, mb = 13, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=5)
+    desc = sl.make_desc(m, m, mb, mb, isrc, jsrc)
+    local = sl.global_to_local(a, desc, grid_2x4)
+    assert len(local) == 8  # single process: all positions addressable
+    for rank, slab in local.items():
+        assert slab.shape == sl.local_shape(desc, grid_2x4.grid_size, rank)
+    mat = sl.matrix_from_local(local, desc, grid_2x4)
+    np.testing.assert_array_equal(mat.to_global(), a)
+    back = sl.matrix_to_local(mat, desc)
+    assert set(back) == set(local)
+    for rank in local:
+        np.testing.assert_array_equal(back[rank], local[rank])
+    fac = sl.ppotrf_local("L", sl.global_to_local(np.tril(a), desc, grid_2x4), desc, grid_2x4)
+    want = np.linalg.cholesky(a)
+    mask = np.tril(np.ones((m, m)))
+    for rank, slab in fac.items():
+        w = sl._slab_from_global(want, desc, grid_2x4.grid_size, rank)
+        msk = sl._slab_from_global(mask, desc, grid_2x4.grid_size, rank)
+        if slab.size:
+            assert np.max(np.abs((slab - w) * msk)) < 1e-10
+
+
+def test_pheevd_local(grid_2x4):
+    """Distributed-buffer eigensolver: slabs in, (w, slabs) out."""
+    m, mb = 12, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=6)
+    desc = sl.make_desc(m, m, mb, mb)
+    w, vloc = sl.pheevd_local("L", sl.global_to_local(np.tril(a), desc, grid_2x4), desc, grid_2x4)
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(a), atol=1e-10)
+    v = sl.matrix_from_local(vloc, desc, grid_2x4).to_global()
+    assert np.max(np.abs(a @ v - v * w[None, :])) < 1e-9
+
+
 def test_pheevd(ctx):
     m = 12
     a = tu.random_hermitian_pd(m, np.complex128, seed=2)
