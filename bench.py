@@ -363,19 +363,19 @@ class _Child:
                     direct_s = dt
                 if self.t_left() < dt + 30:
                     break
+        # factor dominates: n^3/3 + two triangular solves (2*2*n^2*nrhs).
+        # mixed MFU depends only on mixed_s — record it even when the risky
+        # emulated-f64 phase never ran (flush-after-every-stage discipline)
+        flops = n**3 / 3 + 4 * n**2 * 16
+        if self.peak_f32:
+            # the mixed solve spends its flops in the f32 factor
+            rec["mixed_mfu_vs_f32"] = round(flops / mixed_s / 1e12 / self.peak_f32, 4)
         if direct_s is not None:
             rec["direct_f64_s"] = round(direct_s, 3)
             rec["speedup_vs_f64"] = round(direct_s / mixed_s, 2)
-            # factor dominates: n^3/3 + two triangular solves (2*2*n^2*nrhs)
-            flops = n**3 / 3 + 4 * n**2 * 16
             if self.peak_ef64:
                 rec["direct_f64_mfu_vs_ef64_est"] = round(
                     flops / direct_s / 1e12 / self.peak_ef64, 4
-                )
-            if self.peak_f32:
-                # the mixed solve spends its flops in the f32 factor
-                rec["mixed_mfu_vs_f32"] = round(
-                    flops / mixed_s / 1e12 / self.peak_f32, 4
                 )
         return rec
 

@@ -1,0 +1,86 @@
+"""Source-rank transparency for algorithm entry points.
+
+The SPMD kernels assume the matrix origin tile lives on mesh rank (0, 0)
+(_spmd.Geometry).  A matrix distributed with ``source_rank=(sr, sc)``
+occupies exactly the same devices as an origin-(0, 0) matrix over
+``grid.rolled(sr, sc)`` — so nonzero source ranks are handled by
+RE-LABELING, not by generalizing 25 kernels' index algebra
+(reference analogue: Distribution::source_rank_index offsets threaded
+through every algorithm, matrix/distribution.h:115-137; here the offset is
+absorbed into the mesh once, at the entry point):
+
+- operands are re-labeled with :meth:`DistributedMatrix.to_origin`
+  (ZERO traffic: each device's shard is reused byte-for-byte);
+- the wrapped algorithm runs unchanged on the rolled grid;
+- matrix results are re-labeled back to the caller's source rank/grid
+  (zero traffic again), and in-place mutations are mirrored onto the
+  caller's handles so the documented in-place contracts hold.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+
+def _map_result(res, src, grid):
+    """Re-label DistributedMatrix results (also inside tuples/lists and
+    result dataclasses) back to the caller's source rank and grid."""
+    if isinstance(res, DistributedMatrix):
+        return res.with_source_rank(src, grid)
+    if isinstance(res, tuple):
+        return tuple(_map_result(v, src, grid) for v in res)
+    if isinstance(res, list):
+        return [_map_result(v, src, grid) for v in res]
+    if dataclasses.is_dataclass(res) and not isinstance(res, type):
+        ups = {
+            f.name: _map_result(getattr(res, f.name), src, grid)
+            for f in dataclasses.fields(res)
+            if isinstance(getattr(res, f.name), (DistributedMatrix, tuple, list))
+        }
+        return dataclasses.replace(res, **ups) if ups else res
+    return res
+
+
+def origin_transparent(fn):
+    """Decorator for PUBLIC algorithm entry points: lifts nonzero
+    source-rank operands to the origin labeling, and maps results (and
+    in-place mutations) back.  Origin-(0, 0) calls pass through untouched.
+    Mixed source ranks across operands are rejected (the reference likewise
+    requires all operands of one call on one CommunicatorGrid)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        mats = [a for a in list(args) + list(kwargs.values()) if isinstance(a, DistributedMatrix)]
+        srcs = {tuple(m.dist.source_rank) for m in mats}
+        if not mats or srcs == {(0, 0)}:
+            return fn(*args, **kwargs)
+        if len(srcs) > 1:
+            raise ValueError(
+                f"operands disagree on source rank: {sorted(srcs)}; all "
+                "matrices of one call must share it"
+            )
+        src = next(iter(srcs))
+        grid = mats[0].grid
+        views = {}  # id(original) -> (original, origin view)
+        def lift(a):
+            if isinstance(a, DistributedMatrix):
+                v = a.to_origin()
+                views[id(a)] = (a, v)
+                return v
+            return a
+
+        out = fn(*[lift(a) for a in args], **{k: lift(v) for k, v in kwargs.items()})
+        # mirror in-place repointing (algorithms mutate views via _inplace):
+        # re-label each view's CURRENT data back onto the caller's handle —
+        # zero traffic, and a no-op for untouched operands
+        for orig, view in views.values():
+            orig._inplace(
+                DistributedMatrix(view.dist, view.grid, view.data)
+                .with_source_rank(src, grid)
+                .data
+            )
+        return _map_result(out, src, grid)
+
+    return wrapped

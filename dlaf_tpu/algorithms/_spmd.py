@@ -64,6 +64,16 @@ def bucket_ratio() -> float:
     return max(1.01, float(get_tune_parameters().bucket_segment_ratio))
 
 
+def trsm_trace_key() -> bool:
+    """``tune.panel_trsm_pallas`` is consulted at TRACE time inside
+    ops.tile.trsm, so every compiled kernel that traces a trsm must carry
+    it in its compile-cache key — a knob outside the key is a dead knob
+    (the round-4 bt_apply_group_size lesson)."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    return bool(get_tune_parameters().panel_trsm_pallas)
+
+
 def halving_segments(n: int, ratio: float | None = None):
     """Panel-index segments [k0, k1) whose trailing extent shrinks by
     ``ratio`` per segment, so each segment runs with one static

@@ -27,6 +27,8 @@ is garbage exactly as in LAPACK potrf.
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax
@@ -210,7 +212,7 @@ _kernel_cache = {}
 def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
     # only the bucketed variant bakes ratio-dependent segments
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    key = (grid.cache_key, g, uplo, variant, ratio)
+    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key())
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,
@@ -236,7 +238,7 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, np.dtype(mat_a.dtype), uplo)
+    key = (dist, np.dtype(mat_a.dtype), uplo, _spmd.trsm_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -257,6 +259,7 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
         return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
+@origin_transparent
 def cholesky_factorization(
     uplo: str, mat_a: DistributedMatrix, backend: str = "auto", _dump: bool = True
 ) -> DistributedMatrix:

@@ -34,6 +34,8 @@ reduction, bulge chasing and D&C in emulated f64.
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from dataclasses import dataclass
 from functools import partial
 
@@ -174,6 +176,7 @@ def _rotate_clusters(s, g_mat, e, clusters, dtype):
     return e
 
 
+@origin_transparent
 def refine_eigenpairs(
     uplo: str,
     mat_a: DistributedMatrix,
@@ -255,22 +258,249 @@ def refine_eigenpairs(
     return lam_host, x, info
 
 
+@partial(jax.jit, static_argnums=(3,))
+def _col_scale_sub(ax_data, x_data, theta_pad, dist):
+    """R = AX - X diag(theta) on the stacked layout (theta replicated,
+    indexed by global COLUMN)."""
+    gi, gj = _global_element_grids(dist)
+    m, k = dist.size
+    inb = (gi < m) & (gj < k)
+    th = theta_pad[jnp.clip(gj, 0, theta_pad.shape[0] - 1)].astype(x_data.dtype)
+    return jnp.where(inb, ax_data - x_data * th, 0)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _max_abs(data, dist):
+    gi, gj = _global_element_grids(dist)
+    m, k = dist.size
+    r = jnp.where((gi < m) & (gj < k), jnp.abs(data), 0)
+    bad = jnp.any(jnp.isnan(r))
+    return jnp.where(bad, jnp.asarray(jnp.nan, r.dtype), jnp.max(r))
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _pair_scale(c_data, w_pad, theta_pad, tau, dist):
+    """C'[i, j] = C[i, j] / (w_i - theta_j), masked to 0 where the
+    denominator is below ``tau`` (directions the low-precision basis cannot
+    resolve: in-window and boundary-cluster components, handled by the
+    Rayleigh-Ritz step instead)."""
+    gi, gj = _global_element_grids(dist)
+    nn, k = dist.size
+    inb = (gi < nn) & (gj < k)
+    wi = w_pad[jnp.clip(gi, 0, w_pad.shape[0] - 1)]
+    tj = theta_pad[jnp.clip(gj, 0, theta_pad.shape[0] - 1)]
+    denom = (wi - tj).astype(c_data.dtype)
+    safe = jnp.abs(denom) > tau
+    return jnp.where(inb & safe, c_data / jnp.where(safe, denom, 1), 0)
+
+
+def _cholqr(x: DistributedMatrix) -> DistributedMatrix:
+    """Orthonormalize columns by Cholesky QR: G = X^H X, X <- X L^{-H}
+    (distributed k x k factorization + right triangular solve — the
+    near-orthonormal iterates keep G well conditioned)."""
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+
+    target = np.dtype(x.dtype)
+    k = x.size.cols
+    g = general_multiplication(
+        t.CONJ_TRANS, t.NO_TRANS, 1.0, x, x,
+        0.0, DistributedMatrix.zeros(x.grid, (k, k), x.dist.block_size, target),
+    )
+    ell = cholesky_factorization("L", g, _dump=False)
+    return triangular_solver(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, ell, x)
+
+
+def _rr_rotate_window(x, s_kk, g_kk, clusters, target):
+    """Rayleigh-Ritz inside each in-window cluster: rotate X's cluster
+    columns by the k_c x k_c generalized eigenbasis (host solve — the
+    blocks are small; oversize clusters were dropped by _clusters)."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.matrix.window import window_extract, window_update
+
+    n = x.size.rows
+    for i0, i1 in clusters:
+        kc = i1 - i0
+        sc = np.asarray(window_extract(s_kk, (i0, i0), (kc, kc)).to_global())
+        gc = np.asarray(window_extract(g_kk, (i0, i0), (kc, kc)).to_global())
+        sc = (sc + sc.conj().T) / 2
+        gc = (gc + gc.conj().T) / 2
+        try:
+            _theta, y = sla.eigh(sc, gc)
+        except np.linalg.LinAlgError:
+            continue
+        cols = np.asarray(window_extract(x, (0, i0), (n, kc)).to_global())
+        blk = DistributedMatrix.from_global(
+            x.grid, (cols @ y).astype(target), x.dist.block_size
+        )
+        x = window_update(x, (0, i0), blk)
+    return x
+
+
+def refine_partial_eigenpairs(
+    uplo: str,
+    mat_a: DistributedMatrix,
+    v_lo: DistributedMatrix,
+    w_lo: np.ndarray,
+    spectrum: tuple[int, int],
+    max_iters: int = 3,
+) -> tuple[np.ndarray, DistributedMatrix, EigRefineInfo]:
+    """Refine the ``spectrum=(il, iu)`` window of a LOW-precision
+    eigendecomposition to ``mat_a``'s precision, touching only the k =
+    iu-il+1 selected columns with O(n^2 k) work per sweep.
+
+    The Ogita-Aishima within-span correction cannot repair a truncated
+    subspace (docs/ROADMAP.md item 4), so the out-of-span error is removed
+    with a SPECTRAL-PRECONDITIONER sweep instead: the full low-precision
+    eigenbasis (v_lo, w_lo) — which the low pipeline produced anyway — is
+    an f32-accurate diagonalization of A, so
+
+        R   = A X - X diag(theta)            (target-precision GEMM)
+        C   = V_lo^H R                       (low-precision GEMM, MXU)
+        C' := C_ij / (w_i - theta_j)         (masked near-singular pairs)
+        X  <- cholqr(X - V_lo C')            (target-precision update)
+
+    is one step of inverse iteration with an eps_lo-exact preconditioner:
+    each sweep contracts the error by ~eps_lo, so f32 -> f64 in ~2 sweeps.
+    Only the residual GEMM and the CholQR run in (emulated) f64; the two
+    n^2 k projection GEMMs ride the fast low-precision MXU path.  Ritz
+    pairs inside the window that the mask leaves coupled (tight clusters)
+    get a final in-window Rayleigh-Ritz rotation.  A cluster STRADDLING
+    the window boundary is a subspace ambiguity no within-window method
+    can resolve — eigenvalues stay accurate, the individual boundary
+    vectors carry the corresponding mixing (reference behavior under
+    partial-spectrum requests is identical in kind).
+
+    ``v_lo`` is the FULL n x n low-precision eigenbasis, ``w_lo`` all n
+    low-precision eigenvalues ascending.  Returns (w[k], X[n x k], info).
+    """
+    from dlaf_tpu.matrix.util import sub_matrix
+    from dlaf_tpu.tune import matmul_precision
+
+    il, iu = spectrum
+    n = mat_a.size.rows
+    k = iu - il + 1
+    target = np.dtype(mat_a.dtype)
+    low = np.dtype(v_lo.dtype)
+    rdt = np.finfo(np.dtype(target).type(0).real.dtype).dtype
+    eps = np.finfo(rdt).eps
+    eps_lo = np.finfo(np.dtype(low).type(0).real.dtype).eps
+    if not (0 <= il <= iu < n):
+        raise ValueError(f"spectrum {spectrum} outside [0, {n})")
+    if v_lo.size.rows != n or v_lo.size.cols != n or w_lo.shape[0] != n:
+        raise ValueError("refine_partial_eigenpairs needs the full low basis")
+    scale = float(np.max(np.abs(w_lo))) + np.finfo(np.float32).tiny
+    w_dev = jnp.asarray(np.asarray(w_lo, np.dtype(low).type(0).real.dtype))
+    x = sub_matrix(v_lo, (0, il), (n, k)).astype(target)
+    bs = x.dist.block_size
+    info = EigRefineInfo(0, np.inf, False)
+    theta = w_lo[il : iu + 1].astype(rdt)
+    s_kk = g_kk = None
+    with matmul_precision("float32" if target == np.float32 else "highest"):
+        for it in range(max_iters + 1):
+            ax = hermitian_multiplication(
+                t.LEFT, uplo, 1.0, mat_a, x,
+                0.0, DistributedMatrix.zeros(x.grid, (n, k), bs, target),
+            )
+            s_kk = general_multiplication(
+                t.CONJ_TRANS, t.NO_TRANS, 1.0, x, ax,
+                0.0, DistributedMatrix.zeros(x.grid, (k, k), bs, target),
+            )
+            g_kk = general_multiplication(
+                t.CONJ_TRANS, t.NO_TRANS, 1.0, x, x,
+                0.0, DistributedMatrix.zeros(x.grid, (k, k), bs, target),
+            )
+            s_d = _diags(s_kk.data, s_kk.dist)
+            g_d = _diags(g_kk.data, g_kk.dist)
+            theta_dev = (s_d / jnp.where(g_d == 0, 1, g_d)).real.astype(rdt)
+            theta = np.asarray(theta_dev)[:k]
+            r = ax.like(_col_scale_sub(ax.data, x.data, theta_dev, ax.dist))
+            res = float(_max_abs(r.data, r.dist)) / scale
+            info.iters = it
+            info.ortho_error = res  # residual-based for the partial path
+            if res <= n * eps * 50:
+                info.converged = True
+                break
+            if it == max_iters or not np.isfinite(res):
+                break
+            # spectral-preconditioner correction in LOW precision
+            r_lo = r.astype(low)
+            c = general_multiplication(
+                t.CONJ_TRANS, t.NO_TRANS, 1.0, v_lo, r_lo,
+                0.0, DistributedMatrix.zeros(x.grid, (n, k), bs, low),
+            )
+            # directions within ~10 eps_lo of the target Ritz value are not
+            # resolvable by the low basis: mask (RR step handles them)
+            tau = 10.0 * eps_lo * scale
+            c = c.like(
+                _pair_scale(c.data, w_dev, theta_dev.astype(w_dev.dtype), tau, c.dist)
+            )
+            z = general_multiplication(
+                t.NO_TRANS, t.NO_TRANS, 1.0, v_lo, c,
+                0.0, DistributedMatrix.zeros(x.grid, (n, k), bs, low),
+            )
+            x = x.like(x.data - z.data.astype(target))
+            x = _cholqr(x)
+    # in-window clusters: Rayleigh-Ritz rotation (cross-window components
+    # were masked; within-window mixing is resolved exactly here)
+    gap_floor = max(float(np.sqrt(n) * eps * 100), 10.0 * info.ortho_error)
+    cl = _clusters(theta, gap_floor, max_size=min(k, 512))
+    if cl and s_kk is not None:
+        x = _rr_rotate_window(x, s_kk, g_kk, cl, target)
+        # refresh Ritz values for the rotated columns
+        ax = hermitian_multiplication(
+            t.LEFT, uplo, 1.0, mat_a, x,
+            0.0, DistributedMatrix.zeros(x.grid, (n, k), bs, target),
+        )
+        s_kk = general_multiplication(
+            t.CONJ_TRANS, t.NO_TRANS, 1.0, x, ax,
+            0.0, DistributedMatrix.zeros(x.grid, (k, k), bs, target),
+        )
+        g_kk = general_multiplication(
+            t.CONJ_TRANS, t.NO_TRANS, 1.0, x, x,
+            0.0, DistributedMatrix.zeros(x.grid, (k, k), bs, target),
+        )
+        s_d = _diags(s_kk.data, s_kk.dist)
+        g_d = _diags(g_kk.data, g_kk.dist)
+        theta = np.asarray((s_d / jnp.where(g_d == 0, 1, g_d)).real)[:k].astype(rdt)
+    order = np.argsort(theta, kind="stable")
+    if not np.array_equal(order, np.arange(k)):
+        from dlaf_tpu.algorithms.permutations import permute
+
+        x = permute(x, order, "cols")
+        theta = theta[order]
+    return theta, x, info
+
+
+@origin_transparent
 def hermitian_eigensolver_mixed(
     uplo: str,
     mat_a: DistributedMatrix,
     max_iters: int = 3,
     factor_dtype=None,
+    spectrum: tuple[int, int] | None = None,
 ):
-    """HEEV with the five-stage pipeline in LOW precision and Ogita-Aishima
-    refinement in ``mat_a``'s precision (full spectrum only; see module
-    docstring).  ``mat_a`` is not modified.  Returns ``(EigResult, info)``."""
+    """HEEV with the five-stage pipeline in LOW precision and refinement in
+    ``mat_a``'s precision.  Full spectrum uses Ogita-Aishima sweeps; a
+    ``spectrum=(il, iu)`` window uses the spectral-preconditioner partial
+    refinement (:func:`refine_partial_eigenpairs` — the low pipeline still
+    runs fully, since its n x n basis IS the preconditioner, but all
+    target-precision work is O(n^2 k)).  ``mat_a`` is not modified.
+    Returns ``(EigResult, info)``."""
     from dlaf_tpu.algorithms.eigensolver import EigResult, hermitian_eigensolver
     from dlaf_tpu.algorithms.solver import _lower_dtype
 
     target = np.dtype(mat_a.dtype)
     low = _lower_dtype(target, factor_dtype)
     res_lo = hermitian_eigensolver(uplo, mat_a.astype(low))
-    lam, x, info = refine_eigenpairs(
-        uplo, mat_a, res_lo.eigenvectors.astype(target), max_iters=max_iters
+    if spectrum is None:
+        lam, x, info = refine_eigenpairs(
+            uplo, mat_a, res_lo.eigenvectors.astype(target), max_iters=max_iters
+        )
+        return EigResult(lam, x), info
+    lam, x, info = refine_partial_eigenpairs(
+        uplo, mat_a, res_lo.eigenvectors, res_lo.eigenvalues, spectrum,
+        max_iters=max_iters,
     )
     return EigResult(lam, x), info

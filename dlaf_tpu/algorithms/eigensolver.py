@@ -17,6 +17,8 @@ reference, eigensolver/impl.h:52-57) maps to a narrower eigenvector matrix.
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
@@ -41,6 +43,7 @@ class EigResult:
     eigenvectors: DistributedMatrix  # n x k distributed
 
 
+@origin_transparent
 def hermitian_eigensolver(
     uplo: str,
     mat_a: DistributedMatrix,
@@ -264,6 +267,7 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
     return EigResult(np.asarray(w), evecs)
 
 
+@origin_transparent
 def hermitian_eigenvalues(
     uplo: str,
     mat_a: DistributedMatrix,
@@ -294,6 +298,7 @@ def hermitian_eigenvalues(
     )
 
 
+@origin_transparent
 def hermitian_generalized_eigensolver(
     uplo: str,
     mat_a: DistributedMatrix,

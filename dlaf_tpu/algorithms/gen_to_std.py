@@ -33,6 +33,8 @@ reference's single-triangle result).
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax
@@ -147,7 +149,7 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
         return mat_a_full
     if (g.mb, g.pr, g.pc, g.mt) != (g_b.mb, g_b.pr, g_b.pc, g_b.mt):
         raise ValueError("gen_to_std: A and B distributions must match")
-    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio())
+    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio(), _spmd.trsm_trace_key())
     if key not in _cache:
         _cache[key] = coll.spmd(
             mat_a_full.grid,
@@ -167,6 +169,7 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
     return mutil.hermitize(lower, "L")
 
 
+@origin_transparent
 def generalized_to_standard(
     uplo: str, mat_a: DistributedMatrix, mat_b: DistributedMatrix
 ) -> DistributedMatrix:

@@ -23,6 +23,8 @@ storage is returned.
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax.numpy as jnp
@@ -225,7 +227,7 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, str(mat_a.dtype), uplo, diag)
+    key = (dist, str(mat_a.dtype), uplo, diag, _spmd.trsm_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -245,6 +247,7 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
         return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
+@origin_transparent
 def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
     """In-place triangular inverse of the ``uplo`` triangle of A (the other
     triangle is not referenced and returned unchanged structure-wise)."""
@@ -259,7 +262,7 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
 
     # bucketed kernels bake ratio-dependent trailing windows at trace time
     ratio = _spmd.bucket_ratio()
-    key = (mat_a.grid.cache_key, uplo, diag, g, ratio)
+    key = (mat_a.grid.cache_key, uplo, diag, g, ratio, _spmd.trsm_trace_key())
     if key not in _cache:
         kern_fn = (
             _trtri_lower_bucketed_kernel if uplo == t.LOWER else _trtri_upper_bucketed_kernel
@@ -271,6 +274,7 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         return mat_a._inplace(_cache[key](mat_a.data))
 
 
+@origin_transparent
 def inverse_from_cholesky_factor(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:
     """Given the Cholesky factor in the ``uplo`` triangle of A (as produced by
     cholesky_factorization), return A^-1 with FULL Hermitian storage

@@ -25,6 +25,8 @@ This replaces, in one file: `triangular_multiplication`
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax.numpy as jnp
@@ -229,6 +231,7 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
         return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
 
 
+@origin_transparent
 def general_multiplication(
     opa: str, opb: str, alpha, mat_a, mat_b, beta, mat_c
 ) -> DistributedMatrix:
@@ -240,6 +243,7 @@ def general_multiplication(
     return _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, _FULL, t.NON_UNIT, kt)
 
 
+@origin_transparent
 def triangular_multiplication(
     side: str, uplo: str, op: str, diag: str, alpha, mat_a, mat_b
 ) -> DistributedMatrix:
@@ -258,6 +262,7 @@ def triangular_multiplication(
     return _run_summa_right(mat_a, mat_b, out, op, alpha, structure, diag)
 
 
+@origin_transparent
 def hermitian_multiplication(
     side: str, uplo: str, alpha, mat_a, mat_b, beta, mat_c
 ) -> DistributedMatrix:

@@ -18,6 +18,8 @@ Used on real paths: refine_eigenpairs' final eigenvalue reorder
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax
@@ -121,6 +123,7 @@ def _ring_fn(grid, dist, coord):
     return _cache[key]
 
 
+@origin_transparent
 def permute(mat: DistributedMatrix, perm, coord: str = "rows") -> DistributedMatrix:
     """Gather-permutation: rows -> out[i, :] = in[perm[i], :];
     cols -> out[:, j] = in[:, perm[j]]."""

@@ -34,6 +34,8 @@ meaningful afterwards.
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 from typing import Tuple
 
@@ -253,6 +255,7 @@ def get_band_size(nb: int) -> int:
     return nb
 
 
+@origin_transparent
 def reduction_to_band(
     mat_a: DistributedMatrix, band: int | None = None
 ) -> Tuple[DistributedMatrix, jax.Array]:

@@ -23,6 +23,8 @@ fallback when refinement stalls (dsposv's ITER<0 path).
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from dataclasses import dataclass
 
 import numpy as np
@@ -35,6 +37,7 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.ops import tile as t
 
 
+@origin_transparent
 def cholesky_solver(
     uplo: str, mat_l: DistributedMatrix, mat_b: DistributedMatrix
 ) -> DistributedMatrix:
@@ -48,6 +51,7 @@ def cholesky_solver(
     return triangular_solver(t.LEFT, t.UPPER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_l, y)
 
 
+@origin_transparent
 def positive_definite_solver(
     uplo: str, mat_a: DistributedMatrix, mat_b: DistributedMatrix
 ) -> DistributedMatrix:
@@ -80,6 +84,7 @@ def _lower_dtype(dtype, factor_dtype):
     )
 
 
+@origin_transparent
 def positive_definite_solver_mixed(
     uplo: str,
     mat_a: DistributedMatrix,

@@ -14,6 +14,8 @@ Panel workspaces (matrix/panel.h:571-616).
 """
 from __future__ import annotations
 
+from dlaf_tpu.algorithms._origin import origin_transparent
+
 from functools import partial
 
 import jax.numpy as jnp
@@ -360,7 +362,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
     from dlaf_tpu.tune import blas3_precision
 
     da, db = mat_a.dist, mat_b.dist
-    key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha))
+    key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha),
+           _spmd.trsm_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -375,6 +378,7 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
         return mat_b._inplace(_local_cache[key](mat_a.data, mat_b.data))
 
 
+@origin_transparent
 def triangular_solver(
     side: str, uplo: str, op: str, diag: str, alpha, mat_a: DistributedMatrix,
     mat_b: DistributedMatrix, backend: str = "auto"
@@ -424,7 +428,7 @@ def triangular_solver(
         if kern_fn in (_trsm_left_bucketed_kernel, _trsm_right_bucketed_kernel)
         else None
     )
-    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b,
+    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), _spmd.trsm_trace_key(), g_a, g_b,
            lookahead, ratio)
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)

@@ -271,7 +271,26 @@ def _params_kernel(x, lam_prev, beta, *, g, S, B, n_pad, RPD, iters, dt):
     d_next_q = d_next.reshape(-1)[pos]
     gap_q = gap.reshape(-1)[pos]
 
+    # tune.dc_secular_pallas: fused VMEM bisection (pole tables read from
+    # HBM once instead of once per round); bit-matches the XLA loop below.
+    # f32 only (TPU Pallas has no f64); interpret-mode on CPU backends so
+    # the wiring stays testable off-hardware.
+    from dlaf_tpu.tune import get_tune_parameters as _gtp
+
+    use_pallas_secular = bool(
+        getattr(_gtp(), "dc_secular_pallas", False) and dt == jnp.dtype(jnp.float32)
+    )
+
     def bisect(anchor_vec, lo0, hi0):
+        if use_pallas_secular:
+            import jax as _jax
+
+            from dlaf_tpu.ops.pallas_secular import secular_bisect
+
+            return secular_bisect(
+                dw, z2w, rho_q, anchor_vec, lo0, hi0, iters,
+                _jax.default_backend() == "cpu",
+            )
         ag = dw - anchor_vec[:, None]
 
         def body(_, lh):
@@ -608,7 +627,12 @@ def tridiag_dc_distributed(
     stacked = P(ROW_AXIS, COL_AXIS)
 
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key0 = (grid.cache_key, n_pad, s0, nb, str(dt), prec)
+    # dc_secular_pallas is baked at trace time -> must be in the compile key
+    # (round-4 lesson: a knob outside the key is a dead knob)
+    key0 = (
+        grid.cache_key, n_pad, s0, nb, str(dt), prec,
+        bool(getattr(get_tune_parameters(), "dc_secular_pallas", False)),
+    )
     if ("leaf",) + key0 not in _cache:
         nloc = -(-nleaf // Ptot)
         _cache[("leaf",) + key0] = _spmd(

@@ -27,6 +27,17 @@
  *
  * The implementing shared library embeds a CPython interpreter; the
  * dlaf_tpu package must be importable (set PYTHONPATH accordingly).
+ *
+ * Distributed-buffer (per-rank local slab) mode: for MPI-style
+ * applications that hold per-rank block-cyclic locals (the reference's
+ * BLACS model, grid.h:77), the Python layer provides
+ * dlaf_tpu.scalapack.api.{numroc, global_to_local, matrix_from_local,
+ * matrix_to_local, ppotrf_local, pheevd_local} over a multi-process
+ * jax.distributed world — each process passes only its own slabs and no
+ * controller O(N^2) buffer exists (tests/test_multiprocess.py runs it
+ * across 2 real processes).  This C ABI keeps the single-controller
+ * convention above; embed the Python entry points for the local-buffer
+ * path.
  */
 #ifndef DLAF_TPU_C_H
 #define DLAF_TPU_C_H

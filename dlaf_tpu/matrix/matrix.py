@@ -45,6 +45,16 @@ def place(x, sharding) -> jax.Array:
     return jax.device_put(jnp.asarray(x), sharding)
 
 
+def _relabel(x: jax.Array, sharding) -> jax.Array:
+    """Re-wrap an array's EXISTING per-device buffers under a sharding over
+    a reordered mesh of the same devices — zero copies, zero collectives
+    (``device_put``/jit out_shardings both reject cross-order resharding).
+    Only valid when the caller guarantees each device's shard content is
+    the same under both labelings (the Grid.rolled identity)."""
+    arrs = [s.data for s in x.addressable_shards]
+    return jax.make_array_from_single_device_arrays(x.shape, sharding, arrs)
+
+
 def _replicate_fn(grid: Grid):
     """Cached jitted identity with fully-replicated output sharding (one
     compile per mesh, not per to_global call)."""
@@ -167,6 +177,34 @@ class DistributedMatrix:
         if dt == np.dtype(self.dtype):
             return self.like(jnp.copy(self.data))
         return self.like(self.data.astype(dt))
+
+    def to_origin(self) -> "DistributedMatrix":
+        """The same matrix re-labeled to source_rank (0, 0) over
+        ``grid.rolled(sr, sc)`` — ZERO cross-device traffic: tile (g_r, g_c)
+        of a source-(sr, sc) distribution lives on device
+        ((g_r + sr) % Pr, ...), exactly where the rolled grid's origin-(0,0)
+        distribution places it, so only the stacked-axis labeling rolls
+        (each output shard is the input shard already resident on its
+        device; asserted collective-free by tests/test_matrix.py).
+        This is how nonzero source ranks reach the SPMD kernels
+        (reference analogue: Distribution::source_rank_index offsets,
+        matrix/distribution.h:115-137)."""
+        sr, sc = self.dist.source_rank
+        if (sr, sc) == (0, 0):
+            return self
+        rolled = self.grid.rolled(sr, sc)
+        dist0 = Distribution(self.dist.size, self.dist.block_size, self.dist.grid_size)
+        return DistributedMatrix(dist0, rolled, _relabel(self.data, rolled.stacked_sharding()))
+
+    def with_source_rank(self, source_rank, grid: Grid) -> "DistributedMatrix":
+        """Inverse of :func:`to_origin`: re-label an origin-(0, 0) matrix on
+        a rolled grid back to ``source_rank`` on ``grid`` (zero traffic,
+        same shard-residency argument)."""
+        sr, sc = Index2D(*source_rank)
+        if (sr, sc) == (0, 0):
+            return self
+        dist = Distribution(self.dist.size, self.dist.block_size, self.dist.grid_size, Index2D(sr, sc))
+        return DistributedMatrix(dist, grid, _relabel(self.data, grid.stacked_sharding()))
 
     def _inplace(self, data: jax.Array) -> "DistributedMatrix":
         """In-place result semantics for algorithms that donate this matrix's

@@ -140,11 +140,11 @@ def retile(mat: DistributedMatrix, new_block_size) -> DistributedMatrix:
 
 def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
     """Sub-matrix copy at ANY element origin (reference: MatrixRef sub-matrix
-    view, matrix/matrix_ref.h:39).  Multi-device grids with source rank
-    (0,0) take the O(window) ppermute realignment of
-    :mod:`dlaf_tpu.matrix.window`; 1x1 grids and nonzero source ranks (whose
-    rank-shift algebra the window path does not implement) slice the global
-    form under jit — O(parent) relayout, handles any source rank."""
+    view, matrix/matrix_ref.h:39).  Multi-device grids take the O(window)
+    ppermute realignment of :mod:`dlaf_tpu.matrix.window` (nonzero source
+    ranks are re-labeled to origin first — zero traffic,
+    DistributedMatrix.to_origin); 1x1 grids slice the global form under
+    jit."""
     from functools import partial as _p
 
     import jax as _jax
@@ -161,7 +161,9 @@ def sub_matrix(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
         or origin[1] + size[1] > mat.size.cols
     ):
         raise ValueError(f"sub-matrix {origin}+{size} out of bounds {tuple(mat.size)}")
-    if mat.grid.grid_size.count() > 1 and tuple(mat.dist.source_rank) == (0, 0):
+    if mat.grid.grid_size.count() > 1:
+        # any source rank: window_extract re-labels to origin (0,0) first
+        # (DistributedMatrix.to_origin, zero traffic)
         from dlaf_tpu.matrix.window import window_extract
 
         return window_extract(mat, origin, size)

@@ -34,6 +34,26 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 _cache: dict = {}
 
 
+def _reshard_rolled(data, src_grid, dst_grid, roll):
+    """Move a stacked array from ``src_grid``'s mesh onto the rolled
+    ``dst_grid`` (same devices, rolled order): one jitted roll on the source
+    mesh does the physical block ppermute, then the buffers are re-wrapped
+    under the destination sharding (matrix._relabel) — jax's device_put
+    cannot reshard across device orders directly."""
+    import jax
+
+    from dlaf_tpu.matrix.matrix import _relabel
+
+    sr, sc = roll
+    key = ("reshard", src_grid.cache_key, roll, data.shape, str(data.dtype))
+    if key not in _cache:
+        _cache[key] = jax.jit(
+            lambda x: jnp.roll(x, (sr, sc), (0, 1)),
+            out_shardings=src_grid.stacked_sharding(),
+        )
+    return _relabel(_cache[key](data), dst_grid.stacked_sharding())
+
+
 def _axis_extract(x, *, axis, a, d, lt_out, n_out, nt_parent):
     """One-axis window realign of a local tile stack ``x[ltr, ltc, mb, nb]``.
 
@@ -176,11 +196,9 @@ def window_extract(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
     r0, c0 = (int(v) for v in origin)
     m, n = (int(v) for v in size)
     if tuple(mat.dist.source_rank) != (0, 0):
-        raise NotImplementedError(
-            "window_extract: nonzero source_rank (the rank-shift algebra "
-            "assumes tile (0,0) on rank (0,0)); use matrix.util.sub_matrix, "
-            "which falls back to the layout-based path"
-        )
+        # zero-traffic re-labeling to origin (0,0) on the rolled grid; the
+        # extracted window is origin-(0,0) anyway, so nothing to undo
+        mat = mat.to_origin()
     if (
         r0 < 0 or c0 < 0
         or r0 + m > mat.size.rows or c0 + n > mat.size.cols
@@ -209,11 +227,39 @@ def window_update(mat: DistributedMatrix, origin, win: DistributedMatrix) -> Dis
     Returns the updated parent (functional in-place)."""
     r0, c0 = (int(v) for v in origin)
     m, n = win.size
-    if tuple(mat.dist.source_rank) != (0, 0) or tuple(win.dist.source_rank) != (0, 0):
-        raise NotImplementedError(
-            "window_update: nonzero source_rank (the rank-shift algebra "
-            "assumes tile (0,0) on rank (0,0))"
+    if tuple(win.dist.source_rank) != (0, 0):
+        # window content is origin-indexed either way, but to_origin lands
+        # on the ROLLED mesh — reshard the blocks back onto the caller's
+        # mesh (O(window) ppermute) so the merge combines same-mesh data
+        if win.grid.cache_key != mat.grid.cache_key:
+            raise ValueError(
+                "window_update: win and mat must live on the same mesh (got "
+                "different grids — data would combine across device orders)"
+            )
+        sw = tuple(win.dist.source_rank)
+        pr, pc = win.grid.grid_size
+        w0 = win.to_origin()
+        data = _reshard_rolled(
+            w0.data, w0.grid, win.grid, ((-sw[0]) % pr, (-sw[1]) % pc)
         )
+        win = DistributedMatrix(w0.dist, win.grid, data)
+    if tuple(mat.dist.source_rank) != (0, 0):
+        # run on the origin re-labeling (zero traffic), move the window onto
+        # the rolled mesh (REAL O(window) ppermute — the block placements
+        # differ), and relabel the result back into the caller's
+        # distribution so the in-place contract holds
+        src = tuple(mat.dist.source_rank)
+        if win.grid.cache_key != mat.grid.cache_key:
+            raise ValueError(
+                "window_update: win and mat must live on the same mesh (got "
+                "different grids — data would combine across device orders)"
+            )
+        parent0 = mat.to_origin()
+        win0 = DistributedMatrix(
+            win.dist, parent0.grid, _reshard_rolled(win.data, mat.grid, parent0.grid, src)
+        )
+        upd = window_update(parent0, origin, win0)
+        return mat._inplace(upd.with_source_rank(src, mat.grid).data)
     if win.grid.cache_key != mat.grid.cache_key:
         raise ValueError(
             "window_update: win and mat must live on the same mesh (got "

@@ -128,12 +128,32 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "(.h5/.npz via matrix.io)",
     )
     p.add_argument(
+        "--spectrum", default="", metavar="IL:IU",
+        help="partial eigenvalue window, 0-based inclusive indices (e.g. "
+        "0:99 = the 100 smallest); honored by the eigensolver drivers and "
+        "the heev_mixed subcommand (reference --eigensolver-min-band style "
+        "partial-spectrum runs, eigensolver.h:39-256)",
+    )
+    p.add_argument(
         "--stage-times", action="store_true",
         help="print a per-stage wall-time breakdown after each timed run "
         "(syncs at stage boundaries — slightly serializes async dispatch); "
         "instrumented pipelines: eigensolver / gen_eigensolver",
     )
     return p
+
+
+def parse_spectrum(args) -> "tuple[int, int] | None":
+    """(il, iu) from ``--spectrum IL:IU``, or None when unset."""
+    if not getattr(args, "spectrum", ""):
+        return None
+    try:
+        il, iu = (int(v) for v in args.spectrum.split(":"))
+    except ValueError:
+        raise SystemExit(f"--spectrum must be IL:IU, got {args.spectrum!r}")
+    if not (0 <= il <= iu < args.m):
+        raise SystemExit(f"--spectrum {il}:{iu} outside [0, {args.m})")
+    return (il, iu)
 
 
 def tri(uplo: str):

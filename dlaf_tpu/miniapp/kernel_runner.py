@@ -66,6 +66,57 @@ def main(argv=None):
         (l, panel),
         bt * nb**3,
     )
+    # hour-one A/B pair for tune.panel_trsm_pallas (real dtypes): the
+    # column-blocked Pallas panel solve vs the XLA trsm above
+    if np.dtype(dtype).kind == "f" and nb % 32 == 0:
+        from dlaf_tpu.ops.pallas_panel_trsm import panel_trsm_right_lower_t
+
+        flat_panel = panel.reshape(bt * nb, nb)
+        runners["panel_trsm_pallas"] = (
+            lambda lk, b: panel_trsm_right_lower_t(
+                lk, b, False, jax.default_backend() == "cpu"
+            ),
+            (l, flat_panel),
+            bt * nb**3,
+        )
+    # hour-one A/B pair for tune.dc_secular_pallas (f32): fused VMEM
+    # bisection vs the XLA fori_loop formulation
+    if np.dtype(dtype) == np.dtype(np.float32):
+        from jax import lax as _lax
+
+        from dlaf_tpu.ops.pallas_secular import secular_bisect
+
+        K, S, ITERS = 1024, 512, 42
+        rngs = np.random.default_rng(11)
+        dsec = jnp.asarray(np.sort(rngs.standard_normal((K, S)).astype(np.float32), axis=1))
+        z2s = jnp.asarray((rngs.standard_normal((K, S)).astype(np.float32)) ** 2 * 0.1)
+        rhos = jnp.asarray(np.abs(rngs.standard_normal(K).astype(np.float32)) + 0.1)
+        anc = dsec[:, 0] - 0.5
+        lo_s = jnp.zeros(K, jnp.float32)
+        hi_s = jnp.asarray(np.abs(rngs.standard_normal(K).astype(np.float32)) + 0.5)
+        runners["secular_pallas"] = (
+            lambda: secular_bisect(dsec, z2s, rhos, anc, lo_s, hi_s, ITERS,
+                                   jax.default_backend() == "cpu"),
+            (),
+            2.0 * ITERS * K * S,  # div+add per pole per round
+        )
+
+        @jax.jit
+        def _secular_xla():
+            tiny = jnp.finfo(jnp.float32).tiny
+            ag = dsec - anc[:, None]
+
+            def body(_, lh):
+                lo, hi = lh
+                mid = 0.5 * (lo + hi)
+                safe = jnp.where(ag - mid[:, None] == 0, tiny, ag - mid[:, None])
+                fm = 1.0 + rhos * jnp.sum(z2s / safe, axis=1)
+                return jnp.where(fm < 0, mid, lo), jnp.where(fm < 0, hi, mid)
+
+            lo, hi = _lax.fori_loop(0, ITERS, body, (lo_s, hi_s))
+            return 0.5 * (lo + hi)
+
+        runners["secular_xla"] = (_secular_xla, (), 2.0 * ITERS * K * S)
     runners["gemm"] = (
         jax.jit(lambda x, y: jnp.einsum("iab,jcb->ijac", x, y)),
         (panel, panel),

@@ -29,6 +29,7 @@ def main(argv=None):
     a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
 
     uplo = args.uplo
+    spectrum = common.parse_spectrum(args)
 
     def make_input():
         return DistributedMatrix.from_global(grid, common.tri(uplo)(a), (args.mb, args.mb))
@@ -36,7 +37,7 @@ def main(argv=None):
     box = {}
 
     def run(mat):
-        res = hermitian_eigensolver(uplo, mat)
+        res = hermitian_eigensolver(uplo, mat, spectrum=spectrum)
         box["res"] = res
         return res.eigenvectors
 
@@ -48,6 +49,11 @@ def main(argv=None):
         ortho = np.abs(v.conj().T @ v - np.eye(v.shape[1])).max()
         assert rel < tu.tol_for(dtype, args.m, 1000.0), rel
         assert ortho < tu.tol_for(dtype, args.m, 1000.0), ortho
+        if spectrum is not None:
+            ref = np.linalg.eigvalsh(a)[spectrum[0] : spectrum[1] + 1]
+            assert np.abs(w - ref).max() < tu.tol_for(dtype, args.m, 1000.0) * max(
+                np.abs(ref).max(), 1.0
+            )
 
     return common.run_timed(args, make_input, run, check, flops, name="eigensolver")
 

@@ -175,9 +175,10 @@ def main(argv=None):
         if np.dtype(dtype) not in (np.dtype(np.float64), np.dtype(np.complex128)):
             raise SystemExit("heev_mixed needs --type d or z (refines to f64/c128)")
         last = []
+        spectrum = common.parse_spectrum(args)
 
         def run(a):
-            res, info = hermitian_eigensolver_mixed("L", a)
+            res, info = hermitian_eigensolver_mixed("L", a, spectrum=spectrum)
             last[:] = [(res.eigenvalues, info)]
             return res.eigenvectors
 

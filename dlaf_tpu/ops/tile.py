@@ -63,7 +63,27 @@ def op_tile(a, op: str):
 
 def trsm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
     """B := alpha * op(A)^-1 B (Left) or alpha * B op(A)^-1 (Right), A
-    triangular (tile::trsm, blas/tile.h).  Batched over leading axes."""
+    triangular (tile::trsm, blas/tile.h).  Batched over leading axes.
+
+    ``tune.panel_trsm_pallas`` routes the Cholesky-panel case
+    (Right/Lower/T, non-unit, real, 2-D operands) through the
+    column-blocked Pallas VMEM kernel — default off pending hardware A/B."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    if get_tune_parameters().panel_trsm_pallas:
+        from dlaf_tpu.ops import pallas_panel_trsm as ppt
+
+        if ppt.supported(side, uplo, op, diag, a, b):
+            import jax as _jax
+
+            interp = _jax.default_backend() == "cpu"
+            bb = alpha * b
+            if b.ndim == 3:  # batched panel stack [L, mb, nb] -> flat rows
+                out = ppt.panel_trsm_right_lower_t(
+                    a, bb.reshape(-1, b.shape[-1]), op == CONJ_TRANS, interp
+                )
+                return out.reshape(b.shape)
+            return ppt.panel_trsm_right_lower_t(a, bb, op == CONJ_TRANS, interp)
     lower = uplo == LOWER
     # lax.linalg requires identical batch ranks: broadcast A over B's batch
     batch = jnp.broadcast_shapes(a.shape[:-2], b.shape[:-2])

@@ -96,6 +96,14 @@ class TuneParameters:
     - ``band_chase_device_block``: sweeps per device-chase block (bounds
       on-device reflector storage; each block stages its reflectors to
       host on completion).
+    - ``panel_trsm_pallas``: route the Cholesky-panel triangular solve
+      (Right/Lower/T/non-unit, real) through the column-blocked Pallas
+      VMEM kernel (ops/pallas_panel_trsm.py).  Default off: CPU-validated
+      via interpret-mode parity tests, awaiting the hour-one TPU A/B.
+    - ``dc_secular_pallas``: run the D&C secular bisection as the fused
+      Pallas kernel (ops/pallas_secular.py — pole tables resident in VMEM
+      across all rounds instead of one HBM read per round).  Default off,
+      same gating; f32 paths only.
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -128,6 +136,11 @@ class TuneParameters:
     )
     cholesky_lookahead: bool = field(default_factory=lambda: _env("cholesky_lookahead", False, bool))
     trsm_lookahead: bool = field(default_factory=lambda: _env("trsm_lookahead", False, bool))
+    # Pallas panel kernels (VERDICT r4 missing #6 / ROADMAP item 3): landed
+    # CPU-validated (interpret-mode parity tests), DEFAULT OFF until an
+    # on-hardware A/B justifies them — nothing lands unmeasured.
+    panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
+    dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(
         default_factory=lambda: _env("debug_dump_eigensolver_data", False, bool)
     )

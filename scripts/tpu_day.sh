@@ -37,6 +37,13 @@ timeout 600 python -m dlaf_tpu.miniapp.kernel_runner --nb 256 --batch 16 \
   --kernels potrf,potrf_pallas,trsm,gemm,tfactor > "$OUT/03_kernels.txt" 2>&1
 timeout 900 python -m dlaf_tpu.miniapp.kernel_runner --nb 256 --batch 16 \
   --nreps 2 --kernels band_chase > "$OUT/03_band_chase.txt" 2>&1
+# the round-5 Pallas panel kernels: the delete-or-keep A/B for
+# tune.panel_trsm_pallas / dc_secular_pallas (ROADMAP item 3)
+timeout 600 python -m dlaf_tpu.miniapp.kernel_runner --nb 256 --batch 16 \
+  --kernels trsm,panel_trsm_pallas,secular_pallas,secular_xla \
+  > "$OUT/03_pallas_panel_ab.txt" 2>&1
+timeout 600 python -m dlaf_tpu.miniapp.kernel_runner --nb 512 --batch 8 \
+  --kernels trsm,panel_trsm_pallas > "$OUT/03_pallas_panel_ab_512.txt" 2>&1
 
 # 4. per-algorithm sweep (single chip; CSV written through after every
 #    config, so a timeout keeps the finished rows)

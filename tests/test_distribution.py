@@ -125,6 +125,81 @@ def test_sub_distribution():
         d.sub_distribution((20, 20), (8, 8))
 
 
+@pytest.mark.parametrize(
+    "size,block,rank,grid,src,off,g_tiles,l_tiles,l_size",
+    [
+        # ported from the reference offset table (tile == block rows),
+        # /root/reference/test/unit/matrix/test_distribution.cpp:66-101:
+        # {size, block, rank, grid, src_rank, offset,
+        #  global_tiles, local_tiles(rank), local_size(rank)}
+        ((0, 0), (3, 3), (2, 1), (3, 2), (1, 1), (4, 1), (0, 0), (0, 0), (0, 0)),
+        ((1, 32), (13, 21), (2, 1), (3, 2), (0, 0), (1, 1), (1, 2), (0, 1), (0, 12)),
+        ((1, 32), (13, 21), (2, 1), (3, 2), (2, 1), (1, 1), (1, 2), (1, 1), (1, 20)),
+        ((10, 15), (5, 5), (1, 1), (2, 2), (1, 0), (3, 7), (3, 4), (2, 2), (5, 8)),
+        ((13, 16), (13, 16), (4, 5), (9, 8), (2, 3), (32, 32), (2, 1), (1, 1), (7, 16)),
+        ((523, 111), (19, 11), (2, 5), (9, 8), (2, 3), (10, 10), (29, 11), (4, 2), (66, 22)),
+        ((1024, 1024), (32, 32), (3, 2), (6, 4), (1, 1), (48, 48), (33, 33), (6, 9), (192, 256)),
+        ((160, 192), (32, 32), (0, 0), (4, 4), (3, 3), (24, 8), (6, 7), (2, 2), (56, 64)),
+        # block-level columns of the reference's mixed tile/block row :98
+        ((36, 54), (14, 39), (0, 1), (3, 4), (0, 3), (11, 38), (4, 3), (2, 1), (8, 14)),
+    ],
+)
+def test_offset_cases_from_reference(size, block, rank, grid, src, off, g_tiles, l_tiles, l_size):
+    """Reference global-element-OFFSET distributions, expressed in our
+    factorization: offset = whole-block part (absorbed into source_rank)
+    + in-block remainder (a window origin).  The equivalent distribution
+    is Distribution(size + rem, block, grid, src + off // block) viewed at
+    element origin rem — its tile counts and element-ownership must
+    reproduce the reference's expected tables
+    (test_distribution.cpp:66-101 offset rows, :107-124 the
+    source-rank/remainder split our construction mirrors)."""
+    mb, nb = block
+    pr, pc = grid
+    rem = (off[0] % mb, off[1] % nb)
+    eff_src = ((src[0] + off[0] // mb) % pr, (src[1] + off[1] // nb) % pc)
+    # an empty dimension stays empty: the remainder pads only real data
+    sp = tuple(s + r if s else 0 for s, r in zip(size, rem))
+    d = Distribution(sp, block, grid, eff_src)
+    assert tuple(d.nr_tiles) == g_tiles
+    assert tuple(d.local_nr_tiles(rank)) == l_tiles
+    # element ownership of the OFFSET matrix (reference local_size):
+    # element i lives in padded-global tile (i + rem) // block
+    own_r = sum(
+        1 for i in range(size[0])
+        if ((i + rem[0]) // mb + eff_src[0]) % pr == rank[0]
+    )
+    own_c = sum(
+        1 for j in range(size[1])
+        if ((j + rem[1]) // nb + eff_src[1]) % pc == rank[1]
+    )
+    assert (own_r, own_c) == l_size
+    # and our Distribution's own owner algebra agrees elementwise
+    for i in range(0, size[0], max(1, size[0] // 7)):
+        gt = d.global_tile_index((i + rem[0], 0))
+        assert d.rank_global_tile(gt)[0] == ((i + rem[0]) // mb + eff_src[0]) % pr
+
+
+def test_offset_matrix_level(grid_2x4):
+    """Matrix-level check of the same factorization on a real mesh: an
+    offset matrix is a window of a source-rank-shifted parent; values and
+    ownership round-trip through window_extract."""
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+    from dlaf_tpu.matrix.window import window_extract
+
+    mb = 4
+    off = (6, 9)  # blocks (1, 2) + remainder (2, 1)
+    size = (14, 18)
+    rem = (off[0] % mb, off[1] % mb)
+    eff_src = ((off[0] // mb) % 2, (off[1] // mb) % 4)
+    a_pad = tu.random_matrix(size[0] + rem[0], size[1] + rem[1], np.float64, seed=3)
+    parent = DistributedMatrix.from_global(grid_2x4, a_pad, (mb, mb), source_rank=eff_src)
+    win = window_extract(parent, rem, size)
+    np.testing.assert_array_equal(
+        win.to_global(), a_pad[rem[0] : rem[0] + size[0], rem[1] : rem[1] + size[1]]
+    )
+
+
 def test_validation():
     with pytest.raises(ValueError):
         Distribution((4, 4), (0, 4))

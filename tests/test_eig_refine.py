@@ -57,6 +57,74 @@ def test_refine_from_f32(grid_2x4):
     _check_eigh(a, w, v.to_global(), 1e-11)
 
 
+def _check_partial(a, w, v, il, iu, tol):
+    """Partial-window checks: eigenvalues vs the LAPACK window, residual
+    per column, orthonormality of the k columns."""
+    w_ref = np.linalg.eigvalsh(a)[il : iu + 1]
+    np.testing.assert_allclose(w, w_ref, rtol=0, atol=tol * max(np.abs(w_ref).max(), 1.0))
+    scale = max(np.abs(w_ref).max(), 1.0)
+    resid = np.abs(a @ v - v * w[None, :]).max()
+    assert resid <= tol * scale, f"resid {resid:.3e} > {tol * scale:.3e}"
+    ortho = np.abs(v.conj().T @ v - np.eye(v.shape[1])).max()
+    assert ortho <= tol, f"ortho {ortho:.3e}"
+
+
+@pytest.mark.parametrize("uplo", "LU")
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128], ids=str)
+@pytest.mark.parametrize("spectrum", [(0, 23), (17, 52), (80, 95)])
+def test_heev_mixed_partial(grid_2x4, uplo, dtype, spectrum):
+    """Partial-spectrum mixed precision (ROADMAP item 4 / VERDICT r4 weak
+    #7): f32 pipeline + spectral-preconditioner refinement of only the
+    window columns must reach f64-class residuals."""
+    m, nb = 96, 16
+    a = tu.random_hermitian_pd(m, dtype, seed=31)
+    tri = np.tril(a) if uplo == "L" else np.triu(a)
+    mat = DistributedMatrix.from_global(grid_2x4, tri, (nb, nb))
+    res, info = hermitian_eigensolver_mixed(uplo, mat, spectrum=spectrum)
+    il, iu = spectrum
+    assert res.eigenvectors.size.cols == iu - il + 1
+    assert info.converged, f"not converged: {info}"
+    _check_partial(a, res.eigenvalues, res.eigenvectors.to_global(), il, iu, 1e-11)
+
+
+def test_heev_mixed_partial_cluster(grid_2x4):
+    """A tight interior cluster INSIDE the window: the preconditioner mask
+    skips the unresolvable directions and the in-window Rayleigh-Ritz
+    rotation must still deliver f64-class pairs (gap ~1e-13)."""
+    m, nb = 64, 16
+    rng = np.random.default_rng(77)
+    w_plant = np.linspace(1.0, 9.0, m)
+    w_plant[30] = w_plant[29] + 1e-13  # tight pair inside the window
+    w_plant[31] = w_plant[29] + 2e-13
+    q, _ = np.linalg.qr(rng.standard_normal((m, m)))
+    a = (q * w_plant[None, :]) @ q.T
+    a = (a + a.T) / 2
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    res, info = hermitian_eigensolver_mixed("L", mat, spectrum=(20, 40))
+    assert info.converged, info
+    _check_partial(a, res.eigenvalues, res.eigenvectors.to_global(), 20, 40, 1e-11)
+
+
+def test_refine_partial_direct(grid_2x4):
+    """refine_partial_eigenpairs driven directly from a host f32 basis:
+    the window must reach f64 accuracy while only n x k target-precision
+    GEMMs run (spot-check the returned shapes and the f32 starting gap)."""
+    from dlaf_tpu.algorithms.eig_refine import refine_partial_eigenpairs
+
+    m, nb = 64, 16
+    a = tu.random_hermitian_pd(m, np.float64, seed=13)
+    w32, v32 = np.linalg.eigh(a.astype(np.float32))
+    start = np.abs(a @ v32[:, 10:30].astype(np.float64)
+                   - v32[:, 10:30] * w32[None, 10:30]).max()
+    assert start > 1e-9  # genuinely f32-grade input (far above f64 rounding)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    vlo = DistributedMatrix.from_global(grid_2x4, v32, (nb, nb))
+    w, x, info = refine_partial_eigenpairs("L", mat, vlo, w32, (10, 29))
+    assert info.converged
+    assert x.size.rows == m and x.size.cols == 20
+    _check_partial(a, w, x.to_global(), 10, 29, 1e-11)
+
+
 @pytest.mark.slow
 def test_mixed_medium_n(grid_2x4):
     """Slow tier: the mixed solver + eigensolver at N=1024, nb=128 — the

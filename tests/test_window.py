@@ -108,21 +108,100 @@ def test_partial_spectrum_windowed_slice(grid_2x4):
 
 
 def test_sub_matrix_nonzero_source_rank(grid_2x4):
-    """sub_matrix on a matrix with nonzero source_rank must NOT take the
-    window realignment (its rank-shift algebra assumes source (0,0) —
-    advisor r3 medium finding): the layout fallback handles source ranks."""
+    """Nonzero source ranks flow through the window realignment via the
+    zero-traffic origin re-labeling (DistributedMatrix.to_origin) — the
+    r3-era NotImplementedError edges are gone (VERDICT r4 missing #3)."""
     from dlaf_tpu.matrix.util import sub_matrix
 
     a = tu.random_matrix(24, 24, np.float64, seed=9)
     mat = DistributedMatrix.from_global(grid_2x4, a, (8, 8), source_rank=(1, 2))
     got = sub_matrix(mat, (3, 5), (13, 11)).to_global()
     np.testing.assert_array_equal(got, a[3:16, 5:16])
-    # and the window functions reject it loudly rather than mis-shifting
-    with pytest.raises(NotImplementedError):
-        window_extract(mat, (3, 5), (13, 11))
-    win = DistributedMatrix.from_global(grid_2x4, a[:8, :8], (8, 8))
-    with pytest.raises(NotImplementedError):
-        window_update(mat, (0, 0), win)
+    np.testing.assert_array_equal(
+        window_extract(mat, (3, 5), (13, 11)).to_global(), a[3:16, 5:16]
+    )
+    win = DistributedMatrix.from_global(grid_2x4, -a[:8, :8], (8, 8))
+    upd = window_update(mat, (2, 3), win)
+    expect = a.copy()
+    expect[2:10, 3:11] = -a[:8, :8]
+    np.testing.assert_array_equal(upd.to_global(), expect)
+    assert tuple(upd.dist.source_rank) == (1, 2)  # caller's labeling kept
+    np.testing.assert_array_equal(mat.to_global(), expect)  # in-place contract
+
+
+def test_window_update_win_source_rank(grid_2x4):
+    """A WINDOW carrying a nonzero source rank is resharded onto the
+    parent's mesh before the merge — both with an origin parent and a
+    source-rank parent."""
+    a = tu.random_matrix(24, 24, np.float64, seed=17)
+    w = tu.random_matrix(8, 8, np.float64, seed=18)
+    for parent_src in ((0, 0), (1, 1)):
+        mat = DistributedMatrix.from_global(grid_2x4, a, (8, 8), source_rank=parent_src)
+        win = DistributedMatrix.from_global(grid_2x4, w, (8, 8), source_rank=(1, 2))
+        upd = window_update(mat, (4, 5), win)
+        expect = a.copy()
+        expect[4:12, 5:13] = w
+        np.testing.assert_array_equal(upd.to_global(), expect)
+        assert tuple(upd.dist.source_rank) == parent_src
+
+
+def test_to_origin_zero_copy(grid_2x4):
+    """to_origin / with_source_rank are pure re-labelings: same per-device
+    buffers (unsafe_buffer_pointer identity), correct content both ways."""
+    a = tu.random_matrix(20, 20, np.float64, seed=29)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (4, 4), source_rank=(1, 3))
+    m0 = mat.to_origin()
+    np.testing.assert_array_equal(m0.to_global(), a)
+    assert tuple(m0.dist.source_rank) == (0, 0)
+    ptrs = {s.device: s.data.unsafe_buffer_pointer() for s in mat.data.addressable_shards}
+    ptrs0 = {s.device: s.data.unsafe_buffer_pointer() for s in m0.data.addressable_shards}
+    assert ptrs == ptrs0, "to_origin moved data (must be zero-copy)"
+    back = m0.with_source_rank((1, 3), grid_2x4)
+    np.testing.assert_array_equal(back.to_global(), a)
+
+
+def test_algorithms_nonzero_source_rank(grid_2x4):
+    """Public algorithm entries accept nonzero-source-rank operands
+    (origin_transparent wrapper): factorization, solver, GEMM, norm and the
+    full HEEV pipeline — results come back in the caller's labeling and the
+    in-place contract holds (VERDICT r4 missing #3 / _spmd.py edge)."""
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+    from dlaf_tpu.algorithms.multiplication import general_multiplication
+    from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+    from dlaf_tpu.ops import tile as t
+
+    n, nb = 24, 8
+    a = tu.random_hermitian_pd(n, np.float64, seed=41)
+    src = (1, 2)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb), source_rank=src)
+    fac = cholesky_factorization("L", mat)
+    np.testing.assert_allclose(np.tril(fac.to_global()), np.linalg.cholesky(a), atol=1e-10)
+    assert tuple(fac.dist.source_rank) == src
+    np.testing.assert_allclose(  # in-place contract on the caller's handle
+        np.tril(mat.to_global()), np.linalg.cholesky(a), atol=1e-10
+    )
+    b = tu.random_matrix(n, 4, np.float64, seed=42)
+    rhs = DistributedMatrix.from_global(grid_2x4, b, (nb, nb), source_rank=src)
+    x = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, fac, rhs)
+    np.testing.assert_allclose(
+        np.tril(fac.to_global()) @ x.to_global(), b, atol=1e-9
+    )
+    ga = DistributedMatrix.from_global(grid_2x4, a, (nb, nb), source_rank=src)
+    gc = DistributedMatrix.zeros(grid_2x4, (n, n), (nb, nb), np.float64, source_rank=src)
+    prod = general_multiplication("N", "N", 1.0, ga, ga, 0.0, gc)
+    np.testing.assert_allclose(prod.to_global(), a @ a, atol=1e-9)
+    res = hermitian_eigensolver(
+        "L",
+        DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb), source_rank=src),
+        backend="pipeline",
+    )
+    v = res.eigenvectors.to_global()
+    assert np.abs(a @ v - v * res.eigenvalues[None, :]).max() < 1e-9
+    # mixed source ranks across operands must be rejected loudly
+    with pytest.raises(ValueError, match="source rank"):
+        general_multiplication("N", "N", 1.0, ga, mat, 0.0,
+                               DistributedMatrix.zeros(grid_2x4, (n, n), (nb, nb), np.float64))
 
 
 def test_window_update_grid_mismatch(comm_grids):
