@@ -344,6 +344,13 @@ class _Child:
             "fallback": bool(info.fallback),
             "backward_error": float(info.backward_error),
         }
+        # factor dominates: n^3/3 + two triangular solves (2*2*n^2*nrhs);
+        # the mixed MFU depends only on mixed_s, so it goes in BEFORE the
+        # checkpoint — a kill in the risky phase below must not lose it
+        flops = n**3 / 3 + 4 * n**2 * 16
+        if self.peak_f32:
+            # the mixed solve spends its flops in the f32 factor
+            rec["mixed_mfu_vs_f32"] = round(flops / mixed_s / 1e12 / self.peak_f32, 4)
         # checkpoint before the risky emulated-f64 phase: a kill there must
         # not discard the mixed number (flush-after-every-stage discipline)
         self.rec["posv_mixed"] = rec
@@ -363,13 +370,6 @@ class _Child:
                     direct_s = dt
                 if self.t_left() < dt + 30:
                     break
-        # factor dominates: n^3/3 + two triangular solves (2*2*n^2*nrhs).
-        # mixed MFU depends only on mixed_s — record it even when the risky
-        # emulated-f64 phase never ran (flush-after-every-stage discipline)
-        flops = n**3 / 3 + 4 * n**2 * 16
-        if self.peak_f32:
-            # the mixed solve spends its flops in the f32 factor
-            rec["mixed_mfu_vs_f32"] = round(flops / mixed_s / 1e12 / self.peak_f32, 4)
         if direct_s is not None:
             rec["direct_f64_s"] = round(direct_s, 3)
             rec["speedup_vs_f64"] = round(direct_s / mixed_s, 2)

@@ -110,15 +110,22 @@ def supported(side, uplo, op, diag, a, b) -> bool:
     """The Cholesky-panel case this kernel covers: Right/Lower/{T,C},
     non-unit, real, tile-sized factor; ``b`` may be a batched panel stack
     ([L, mb, nb] — the distributed kernels' shape) or a flat (m, nb)."""
+    import jax as _jax
+
     from dlaf_tpu.ops import tile as t
 
     rows = int(np.prod(b.shape[:-1])) if b.ndim >= 2 else 0
+    # TPU Pallas has no f64: compiled runs are f32-only (CPU runs go
+    # through interpret mode, where f64 parity tests are valid)
+    dtype_ok = np.dtype(a.dtype) == np.dtype(np.float32) or (
+        np.dtype(a.dtype).kind == "f" and _jax.default_backend() == "cpu"
+    )
     return (
         side == t.RIGHT
         and uplo == t.LOWER
         and op in (t.TRANS, t.CONJ_TRANS)
         and diag == t.NON_UNIT
-        and np.dtype(a.dtype).kind == "f"
+        and dtype_ok
         and a.ndim == 2
         and b.ndim in (2, 3)
         and b.shape[-1] == a.shape[-1]

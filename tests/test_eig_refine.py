@@ -125,6 +125,22 @@ def test_refine_partial_direct(grid_2x4):
     _check_partial(a, w, x.to_global(), 10, 29, 1e-11)
 
 
+def test_refine_partial_source_rank(grid_2x4):
+    """refine_partial_eigenpairs is origin-transparent like every public
+    entry: source-rank operands work and results come back correct."""
+    from dlaf_tpu.algorithms.eig_refine import refine_partial_eigenpairs
+
+    m, nb = 48, 8
+    a = tu.random_hermitian_pd(m, np.float64, seed=23)
+    w32, v32 = np.linalg.eigh(a.astype(np.float32))
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb), source_rank=(1, 2))
+    vlo = DistributedMatrix.from_global(grid_2x4, v32, (nb, nb), source_rank=(1, 2))
+    w, x, info = refine_partial_eigenpairs("L", mat, vlo, w32, (8, 27))
+    assert info.converged
+    v = x.to_global()
+    assert np.abs(a @ v - v * w[None, :]).max() < 1e-11 * max(1.0, np.abs(w).max()) * m
+
+
 @pytest.mark.slow
 def test_mixed_medium_n(grid_2x4):
     """Slow tier: the mixed solver + eigensolver at N=1024, nb=128 — the
