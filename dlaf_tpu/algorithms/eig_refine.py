@@ -335,16 +335,22 @@ def refine_partial_eigenpairs(
         C' := C_ij / (w_i - theta_j)         (masked near-singular pairs)
         X  <- cholqr(X - V_lo C')            (target-precision update)
 
-    is one step of inverse iteration with an eps_lo-exact preconditioner:
-    each sweep contracts the error by ~eps_lo, so f32 -> f64 in ~2 sweeps.
-    Only the residual GEMM and the CholQR run in (emulated) f64; the two
-    n^2 k projection GEMMs ride the fast low-precision MXU path.  Ritz
-    pairs inside the window that the mask leaves coupled (tight clusters)
-    get a final in-window Rayleigh-Ritz rotation.  A cluster STRADDLING
-    the window boundary is a subspace ambiguity no within-window method
-    can resolve — eigenvalues stay accurate, the individual boundary
-    vectors carry the corresponding mixing (reference behavior under
-    partial-spectrum requests is identical in kind).
+    is one step of inverse iteration with an eps_lo-exact preconditioner.
+    Every sweep ALSO performs a full in-window Rayleigh-Ritz rotation
+    (k x k host solve + n k^2 rotation GEMMs): the f32 basis mixes
+    within-window directions at the eps_lo*||A||/gap level, and correcting
+    those through the preconditioner re-injects basis noise — RR resolves
+    the in-span part exactly in target precision, the preconditioner only
+    touches out-of-span error (LOBPCG-style; measured necessary at
+    N=1024, docs/BENCHMARKS.md round-5).  The projection GEMMs ride the
+    fast low-precision MXU path and escalate to target precision if the
+    residual stalls.  A cluster STRADDLING the window boundary is a
+    subspace ambiguity no within-window method can resolve — eigenvalues
+    stay accurate, the individual boundary vectors carry the mixing
+    (reference behavior under partial-spectrum requests is identical in
+    kind).  The per-sweep host RR is O(k^3): callers should route wide
+    windows (k approaching n) to the full Ogita-Aishima path instead
+    (hermitian_eigensolver_mixed does this automatically).
 
     ``v_lo`` is the FULL n x n low-precision eigenbasis, ``w_lo`` all n
     low-precision eigenvalues ascending.  Returns (w[k], X[n x k], info).
@@ -452,7 +458,8 @@ def refine_partial_eigenpairs(
             # precision while they contract, escalated to target once stalled
             if use_hi:
                 if v_hi is None:
-                    v_hi = v_lo.astype(target)
+                    # same-precision call: the basis is read-only, no copy
+                    v_hi = v_lo if np.dtype(low) == target else v_lo.astype(target)
                 basis, rproj, pdt = v_hi, r, target
             else:
                 basis, rproj, pdt = v_lo, r.astype(low), low
@@ -502,10 +509,24 @@ def hermitian_eigensolver_mixed(
     target = np.dtype(mat_a.dtype)
     low = _lower_dtype(target, factor_dtype)
     res_lo = hermitian_eigensolver(uplo, mat_a.astype(low))
-    if spectrum is None:
+    n = mat_a.size.rows
+    # wide windows: the partial path's per-sweep k x k host RR is O(k^3),
+    # so once k is a sizable fraction of n the full Ogita-Aishima sweeps
+    # (all-distributed, ~4 n^3 GEMM flops/sweep) are the better tool —
+    # refine fully and slice the window columns
+    wide = spectrum is not None and (
+        spectrum[1] - spectrum[0] + 1 > max(512, n // 2)
+    )
+    if spectrum is None or wide:
         lam, x, info = refine_eigenpairs(
             uplo, mat_a, res_lo.eigenvectors.astype(target), max_iters=max_iters
         )
+        if spectrum is not None:
+            from dlaf_tpu.matrix.util import sub_matrix
+
+            il, iu = spectrum
+            x = sub_matrix(x, (0, il), (n, iu - il + 1))
+            lam = lam[il : iu + 1]
         return EigResult(lam, x), info
     lam, x, info = refine_partial_eigenpairs(
         uplo, mat_a, res_lo.eigenvectors, res_lo.eigenvalues, spectrum,

@@ -128,6 +128,11 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "(.h5/.npz via matrix.io)",
     )
     p.add_argument(
+        "--print-config", action="store_true",
+        help="dump the effective tune configuration + runtime facts before "
+        "running (reference --dlaf:print-config, src/init.cpp:377-383)",
+    )
+    p.add_argument(
         "--spectrum", default="", metavar="IL:IU",
         help="partial eigenvalue window, 0-based inclusive indices (e.g. "
         "0:99 = the 100 smallest); honored by the eigensolver drivers and "
@@ -192,6 +197,10 @@ def reject_input_file(args, driver: str) -> None:
 def make_grid(args) -> Grid:
     if args.type in ("d", "z"):  # 64-bit real parts need x64; c (c64) does not
         jax.config.update("jax_enable_x64", True)
+    if getattr(args, "print_config", False):
+        from dlaf_tpu.tune import print_config
+
+        print_config()
     return Grid.create(Size2D(args.grid_rows, args.grid_cols))
 
 

@@ -175,6 +175,25 @@ def initialize(**overrides) -> TuneParameters:
     return _params.update(**overrides)
 
 
+def print_config(file=None) -> None:
+    """Dump the effective configuration (reference --dlaf:print-config,
+    src/init.cpp:377-383): every tune knob with its current value plus the
+    JAX runtime facts the knobs' auto modes key on."""
+    import sys
+
+    import jax
+
+    out = file or sys.stdout
+    print("dlaf_tpu configuration:", file=out)
+    print(f"  backend: {jax.default_backend()}  devices: {jax.device_count()}"
+          f"  processes: {jax.process_count()}  x64: {jax.config.jax_enable_x64}",
+          file=out)
+    p = get_tune_parameters()
+    for f in fields(p):
+        print(f"  {f.name}: {getattr(p, f.name)}  (env DLAF_TPU_{f.name.upper()})",
+              file=out)
+
+
 # user-facing spellings -> jax.default_matmul_precision enum values
 # ('high' == three bf16 passes on TPU MXU, 'highest'/'float32' == six)
 _PRECISION_ALIASES = {"bfloat16_3x": "high", "bf16_3x": "high", "f32": "float32"}

@@ -60,6 +60,24 @@ print(
     f"mixed heev: ortho error {einfo.ortho_error:.1e} after "
     f"{einfo.iters} sweeps (f32 pipeline, f64 eigenpairs)"
 )
+pres, pinfo = dt.hermitian_eigensolver_mixed(
+    "L", dt.DistributedMatrix.from_global(grid, np.tril(a64), (nb, nb)),
+    spectrum=(0, 31),
+)
+print(
+    f"mixed partial heev (32 smallest): residual {pinfo.ortho_error:.1e} "
+    f"after {pinfo.iters} sweeps — target-precision work is O(n^2 k)"
+)
+
+# --- distributed-buffer ScaLAPACK mode (per-rank local slabs) -----------------
+desc64 = sl.make_desc(n, n, nb, nb)
+local = sl.global_to_local(np.tril(a64), desc64, grid)  # this process's slabs
+fac_slabs = sl.ppotrf_local("L", local, desc64, grid)
+print(
+    f"local-buffer ppotrf: {len(fac_slabs)} rank slab(s) held by this "
+    "process, no global buffer assembled (on a multi-process world each "
+    "process passes only its own slabs — see docs/MIGRATION.md)"
+)
 
 # --- IO -----------------------------------------------------------------------
 mio.save("/tmp/demo_matrix.npz", fac)

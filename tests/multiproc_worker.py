@@ -134,10 +134,54 @@ def case_scalapack_local(grid, args):
     ), np.max(np.abs(resid))
 
 
+def case_hegv(grid, args):
+    """Generalized HEGV pipeline across processes (gen_to_std + HEEV +
+    back-substitution), B-orthonormality checked on every rank."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.eigensolver import hermitian_generalized_eigensolver
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    a = tu.random_hermitian_pd(args.n, np.float64, seed=33)
+    b = tu.random_hermitian_pd(args.n, np.float64, seed=34)
+    mat_a = DistributedMatrix.from_global(grid, np.tril(a), (args.nb, args.nb))
+    mat_b = DistributedMatrix.from_global(grid, np.tril(b), (args.nb, args.nb))
+    res = hermitian_generalized_eigensolver("L", mat_a, mat_b)
+    tol = tu.tol_for(np.float64, args.n, 500.0)
+    v = res.eigenvectors.to_global()
+    resid = a @ v - (b @ v) * res.eigenvalues[None, :]
+    assert np.max(np.abs(resid)) < tol * max(1.0, np.abs(a).max()), np.max(np.abs(resid))
+    ortho = v.conj().T @ b @ v - np.eye(v.shape[1])
+    assert np.max(np.abs(ortho)) < tol, np.max(np.abs(ortho))
+
+
+def case_heev_c128(grid, args):
+    """Complex-Hermitian HEEV pipeline across processes."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    a = tu.random_hermitian_pd(args.n, np.complex128, seed=35)
+    mat = DistributedMatrix.from_global(grid, np.tril(a), (args.nb, args.nb))
+    res = hermitian_eigensolver("L", mat, backend="pipeline")
+    tol = tu.tol_for(np.complex128, args.n, 500.0)
+    np.testing.assert_allclose(res.eigenvalues, np.linalg.eigvalsh(a), atol=tol)
+    v = res.eigenvectors.to_global()
+    resid = a @ v - v * res.eigenvalues[None, :]
+    assert np.max(np.abs(resid)) < tol * max(1.0, np.abs(a).max()), np.max(np.abs(resid))
+    ortho = v.conj().T @ v - np.eye(v.shape[1])
+    assert np.max(np.abs(ortho)) < tol, np.max(np.abs(ortho))
+
+
 CASES = {
     "roundtrip": case_roundtrip,
     "potrf": case_potrf,
     "heev": case_heev,
+    "hegv": case_hegv,
+    "heev_c128": case_heev_c128,
     "scalapack_local": case_scalapack_local,
 }
 

@@ -42,6 +42,19 @@ def test_mp2_scalapack_local():
     run_world(2, 4, "scalapack_local", n=32, nb=8)
 
 
+def test_mp2_hegv():
+    """2 processes x 4 devices: generalized HEGV pipeline across processes
+    (gen_to_std + HEEV + back-substitution, B-orthonormality per rank)."""
+    run_world(2, 4, "hegv", n=21, nb=5)
+
+
+@pytest.mark.slow
+def test_mp2_heev_c128():
+    """2 processes x 4 devices: complex-Hermitian pipeline (slow: complex
+    compiles are the heaviest in the suite)."""
+    run_world(2, 4, "heev_c128", n=21, nb=5)
+
+
 def test_mp4_potrf():
     """4 processes x 2 devices (2x4 grid): distributed Cholesky residual."""
     run_world(4, 2, "potrf", n=32, nb=8)
