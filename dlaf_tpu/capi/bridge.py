@@ -181,6 +181,29 @@ def c_posv(uplo, a_addr, desca, b_addr, descb, dtype_str) -> int:
         return 1
 
 
+def c_posv_mixed(uplo, a_addr, desca, b_addr, descb, iter_addr, dtype_str) -> int:
+    """dsposv/zcposv analogue: a is read-only, x overwrites b, the LAPACK
+    ITER value (negative = full-precision fallback) is written through
+    ``iter_addr``."""
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import pposv_mixed
+
+        a = _view(a_addr, desca, dtype)
+        b = _view(b_addr, descb, dtype)
+        x, it = pposv_mixed(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            np.ascontiguousarray(b), _descriptor(descb),
+        )
+        b[:, :] = x
+        ctypes.c_int.from_address(int(iter_addr)).value = int(it)
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
 def c_trsm(side, uplo, trans, diag, are, aim, a_addr, desca, b_addr, descb, dtype_str) -> int:
     try:
         dtype = np.dtype(dtype_str)

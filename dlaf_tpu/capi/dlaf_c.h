@@ -102,6 +102,17 @@ int dlaf_pdposv(char uplo, double* a, const int desca[9], double* b, const int d
 int dlaf_pcposv(char uplo, dlaf_complex_c* a, const int desca[9], dlaf_complex_c* b, const int descb[9]);
 int dlaf_pzposv(char uplo, dlaf_complex_z* a, const int desca[9], dlaf_complex_z* b, const int descb[9]);
 
+/* Mixed-precision factor+solve (LAPACK dsposv / zcposv analogue, a
+ * dlaf_tpu extension — the reference has no mixed precision): the
+ * Cholesky factorization runs in f32/c64 on the MXU and iterative
+ * refinement recovers the f64/c128 solution; ITER is written through
+ * `iter` (LAPACK convention: sweep count, negative when the
+ * full-precision fallback engaged).  `a` is not modified.  */
+int dlaf_pdsposv(char uplo, double* a, const int desca[9], double* b,
+                 const int descb[9], int* iter);
+int dlaf_pzcposv(char uplo, dlaf_complex_z* a, const int desca[9],
+                 dlaf_complex_z* b, const int descb[9], int* iter);
+
 /* ---- Triangular solve: op(A) X = alpha B (side 'L') or X op(A) =
  * alpha B (side 'R'); B is overwritten with X.  trans 'N'/'T'/'C'. ---- */
 int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,

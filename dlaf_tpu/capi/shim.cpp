@@ -221,6 +221,30 @@ DLAF_SOLVE_FAMILY(d, double, "f8")
 DLAF_SOLVE_FAMILY(c, dlaf_complex_c, "c8")
 DLAF_SOLVE_FAMILY(z, dlaf_complex_z, "c16")
 
+/* mixed-precision posv (LAPACK dsposv/zcposv analogue): low-precision
+ * factor + refinement, ITER written through `iter` (negative = the
+ * full-precision fallback produced the result); `a` is left unmodified. */
+static int run_posv_mixed(char uplo, void* a, const int desca[9], void* b,
+                          const int descb[9], int* iter, const char* dt) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKNKs)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), (unsigned long long)(uintptr_t)b, desc_tuple(descb),
+      (unsigned long long)(uintptr_t)iter, dt);
+  int info = run_info("c_posv_mixed", args);
+  PyGILState_Release(st);
+  return info;
+}
+int dlaf_pdsposv(char uplo, double* a, const int desca[9], double* b,
+                 const int descb[9], int* iter) {
+  return run_posv_mixed(uplo, a, desca, b, descb, iter, "f8");
+}
+int dlaf_pzcposv(char uplo, dlaf_complex_z* a, const int desca[9],
+                 dlaf_complex_z* b, const int descb[9], int* iter) {
+  return run_posv_mixed(uplo, a, desca, b, descb, iter, "c16");
+}
+
 int dlaf_pstrsm(char side, char uplo, char trans, char diag, float alpha,
                 float* a, const int desca[9], float* b, const int descb[9]) {
   return run_trsm(side, uplo, trans, diag, alpha, 0.0, a, desca, b, descb, "f4");

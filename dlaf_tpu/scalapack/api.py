@@ -328,6 +328,63 @@ def pheevd_local(
     return res.eigenvalues, matrix_to_local(res.eigenvectors, desc)
 
 
+def ppotrs_local(
+    uplo: str,
+    local_a: Dict[Tuple[int, int], np.ndarray], desc_a: Descriptor,
+    local_b: Dict[Tuple[int, int], np.ndarray], desc_b: Descriptor,
+    grid: Grid,
+) -> Dict[Tuple[int, int], np.ndarray]:
+    """Solve from a Cholesky factor in distributed-buffer mode."""
+    from dlaf_tpu.algorithms.solver import cholesky_solver
+
+    _check_same_source(desc_a, desc_b)
+    x = cholesky_solver(
+        uplo, matrix_from_local(local_a, desc_a, grid),
+        matrix_from_local(local_b, desc_b, grid),
+    )
+    return matrix_to_local(x, desc_b)
+
+
+def pposv_local(
+    uplo: str,
+    local_a: Dict[Tuple[int, int], np.ndarray], desc_a: Descriptor,
+    local_b: Dict[Tuple[int, int], np.ndarray], desc_b: Descriptor,
+    grid: Grid,
+) -> Tuple[Dict[Tuple[int, int], np.ndarray], Dict[Tuple[int, int], np.ndarray]]:
+    """Factor + solve in distributed-buffer mode.  Returns (factor slabs,
+    solution slabs) for this process's grid ranks."""
+    from dlaf_tpu.algorithms.solver import positive_definite_solver
+
+    _check_same_source(desc_a, desc_b)
+    mat_a = matrix_from_local(local_a, desc_a, grid)
+    x = positive_definite_solver(uplo, mat_a, matrix_from_local(local_b, desc_b, grid))
+    return matrix_to_local(mat_a, desc_a), matrix_to_local(x, desc_b)
+
+
+def phegvd_local(
+    uplo: str,
+    local_a: Dict[Tuple[int, int], np.ndarray], desc_a: Descriptor,
+    local_b: Dict[Tuple[int, int], np.ndarray], desc_b: Descriptor,
+    grid: Grid,
+    spectrum: Optional[Tuple[int, int]] = None, factorized: bool = False,
+) -> Tuple[np.ndarray, Dict[Tuple[int, int], np.ndarray]]:
+    """Generalized Hermitian eigensolver in distributed-buffer mode.
+    Returns (eigenvalues [replicated host], eigenvector slabs)."""
+    from dlaf_tpu.algorithms.eigensolver import hermitian_generalized_eigensolver
+
+    _check_same_source(desc_a, desc_b)
+    res = hermitian_generalized_eigensolver(
+        uplo, matrix_from_local(local_a, desc_a, grid),
+        matrix_from_local(local_b, desc_b, grid),
+        spectrum=spectrum, factorized=factorized,
+    )
+    return res.eigenvalues, matrix_to_local(res.eigenvectors, desc_a)
+
+
+psygvd_local = phegvd_local  # real-symmetric alias
+psyevd_local = pheevd_local  # real-symmetric alias (defined above)
+
+
 def ppotrf(ctx: int, uplo: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
     """Cholesky factorization (dlaf_pspotrf/pdpotrf/pcpotrf/pzpotrf)."""
     from dlaf_tpu.algorithms.cholesky import cholesky_factorization
@@ -385,6 +442,26 @@ def pposv(
     mat_a = _dist(ctx, a, desc_a)
     x = positive_definite_solver(uplo, mat_a, _dist(ctx, b, desc_b))
     return mat_a.to_global(), x.to_global()
+
+
+def pposv_mixed(
+    ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
+    b: np.ndarray, desc_b: Descriptor,
+) -> Tuple[np.ndarray, int]:
+    """Mixed-precision factor + solve (the LAPACK dsposv/zcposv analogue
+    on the grid): low-precision Cholesky + iterative refinement, full-
+    precision fallback on stall.  ``a`` is NOT modified (matching dsposv's
+    contract when refinement converges).  Returns ``(X, iter)`` with
+    LAPACK's ITER convention: refinement sweep count when converged,
+    negative when the full-precision fallback produced the result."""
+    from dlaf_tpu.algorithms.solver import positive_definite_solver_mixed
+
+    _check_same_source(desc_a, desc_b)
+    x, info = positive_definite_solver_mixed(
+        uplo, _dist(ctx, a, desc_a), _dist(ctx, b, desc_b)
+    )
+    it = -(info.iters + 1) if info.fallback else info.iters
+    return x.to_global(), it
 
 
 def pgemm(

@@ -79,6 +79,11 @@ for APP in posv posv_mixed heev_mixed; do
     --m 8192 --mb 512 --type d --nruns 1 --check last \
     > "$OUT/05_mixed_$APP.txt" 2>&1
 done
+#    (e) PARTIAL-spectrum mixed (round 5): O(n^2 k) target-precision work —
+#        the 1024 smallest of N=8192 vs the full mixed run above
+timeout 900 python -m dlaf_tpu.miniapp.miniapp_suite heev_mixed \
+  --m 8192 --mb 512 --type d --nruns 1 --spectrum 0:1023 --check last \
+  > "$OUT/05_mixed_heev_partial.txt" 2>&1
 
 # 6. one profiler trace for the record
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \

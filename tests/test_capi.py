@@ -179,6 +179,51 @@ def test_capi_inprocess_potrs_posv(shim):
     lib.dlaf_free_grid(ctx)
 
 
+def test_capi_inprocess_sposv_mixed(shim):
+    """dlaf_pdsposv / dlaf_pzcposv (LAPACK dsposv/zcposv analogues): f64
+    solve via f32 factor + refinement; ITER out-param positive (converged
+    without fallback); A unmodified."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pdsposv.restype = ctypes.c_int
+    lib.dlaf_pzcposv.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb, k = 16, 4, 3
+    dp = ctypes.POINTER(ctypes.c_double)
+    a = _spd(n, np.float64, seed=15)
+    b = np.random.default_rng(16).standard_normal((n, k))
+    abuf, bbuf = np.asfortranarray(np.tril(a)), np.asfortranarray(b)
+    a_before = abuf.copy()
+    it = ctypes.c_int(-999)
+    rc = lib.dlaf_pdsposv(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        bbuf.ctypes.data_as(dp), _desc9(ctx, n, k, nb, nb),
+        ctypes.byref(it),
+    )
+    assert rc == 0
+    assert it.value >= 0, f"fallback engaged: iter={it.value}"
+    np.testing.assert_allclose(a @ bbuf, b, atol=1e-10 * np.abs(a).max())
+    np.testing.assert_array_equal(abuf, a_before)  # A untouched
+    # complex
+    rng = np.random.default_rng(17)
+    az = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    az = az @ az.conj().T + n * np.eye(n)
+    bz = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))
+    azbuf, bzbuf = np.asfortranarray(np.tril(az)), np.asfortranarray(bz)
+    itz = ctypes.c_int(-999)
+    rc = lib.dlaf_pzcposv(
+        ctypes.c_char(b"L"),
+        azbuf.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, n, nb, nb),
+        bzbuf.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, k, nb, nb),
+        ctypes.byref(itz),
+    )
+    assert rc == 0
+    assert itz.value >= 0, f"complex mixed path fell back: iter={itz.value}"
+    np.testing.assert_allclose(az @ bzbuf, bz, atol=1e-9 * np.abs(az).max())
+    lib.dlaf_free_grid(ctx)
+
+
 def test_capi_inprocess_partial_spectrum(shim):
     """dlaf_pdsyevd_partial_spectrum: 1-based inclusive [il, iu]
     (reference eigensolver.h:121-127 eigenvalues_index_begin/end)."""

@@ -100,6 +100,34 @@ def test_local_buffer_roundtrip(grid_2x4, isrc, jsrc):
             assert np.max(np.abs((slab - w) * msk)) < 1e-10
 
 
+def test_solver_local_drivers(grid_2x4):
+    """Distributed-buffer solver drivers: potrs/posv round-trip and the
+    generalized eigensolver, all slabs-in/slabs-out."""
+    m, mb, nrhs = 16, 4, 3
+    a = tu.random_hermitian_pd(m, np.float64, seed=8)
+    b = tu.random_matrix(m, nrhs, np.float64, seed=9)
+    da = sl.make_desc(m, m, mb, mb)
+    db = sl.make_desc(m, nrhs, mb, mb)
+    la = sl.global_to_local(np.tril(a), da, grid_2x4)
+    lb = sl.global_to_local(b, db, grid_2x4)
+    lfac, lx = sl.pposv_local("L", la, da, lb, db, grid_2x4)
+    x = sl.matrix_from_local(lx, db, grid_2x4).to_global()
+    np.testing.assert_allclose(a @ x, b, atol=1e-10)
+    # potrs from the returned factor slabs
+    lb2 = sl.global_to_local(2.0 * b, db, grid_2x4)
+    lx2 = sl.ppotrs_local("L", lfac, da, lb2, db, grid_2x4)
+    x2 = sl.matrix_from_local(lx2, db, grid_2x4).to_global()
+    np.testing.assert_allclose(a @ x2, 2.0 * b, atol=1e-10)
+    # generalized eigensolver
+    bmat = tu.random_hermitian_pd(m, np.float64, seed=10)
+    lbm = sl.global_to_local(np.tril(bmat), da, grid_2x4)
+    w, lv = sl.phegvd_local("L", sl.global_to_local(np.tril(a), da, grid_2x4), da,
+                            lbm, da, grid_2x4)
+    v = sl.matrix_from_local(lv, da, grid_2x4).to_global()
+    assert np.abs(a @ v - (bmat @ v) * w[None, :]).max() < 1e-9
+    assert sl.psyevd_local is sl.pheevd_local
+
+
 def test_pheevd_local(grid_2x4):
     """Distributed-buffer eigensolver: slabs in, (w, slabs) out."""
     m, mb = 12, 4
