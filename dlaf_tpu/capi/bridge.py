@@ -282,6 +282,35 @@ def c_syevd(uplo, a_addr, desca, w_addr, z_addr, descz, dtype_str, il=0, iu=0) -
         return 1
 
 
+def c_syevd_mixed(
+    uplo, a_addr, desca, w_addr, z_addr, descz, iter_addr, dtype_str, il=0, iu=0
+) -> int:
+    """Mixed-precision eigensolver: w/z written through the caller's
+    buffers, the refinement ITER (negative = not converged) through
+    ``iter_addr``; ``a`` is not modified."""
+    try:
+        dtype = np.dtype(dtype_str)
+        _setup_jax(dtype)
+        from dlaf_tpu.scalapack.api import pheevd_mixed
+
+        a = _view(a_addr, desca, dtype)
+        z = _view(z_addr, descz, dtype)
+        n = int(desca[2])
+        spectrum = _spectrum(n, int(il), int(iu))
+        k = n if spectrum is None else spectrum[1] - spectrum[0] + 1
+        ev, evec, it = pheevd_mixed(
+            int(desca[1]), str(uplo), np.ascontiguousarray(a), _descriptor(desca),
+            spectrum=spectrum,
+        )
+        _wview(w_addr, k, dtype)[:] = ev
+        z[:, :k] = evec
+        ctypes.c_int.from_address(int(iter_addr)).value = int(it)
+        return 0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        return 1
+
+
 def c_sygvd(
     uplo, a_addr, desca, b_addr, descb, w_addr, z_addr, descz, dtype_str,
     il=0, iu=0, factorized=0,

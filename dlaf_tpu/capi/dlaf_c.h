@@ -173,6 +173,26 @@ int dlaf_pzheevd_partial_spectrum(char uplo, dlaf_complex_z* a,
                                   dlaf_complex_z* z, const int descz[9],
                                   long il, long iu);
 
+/* Mixed-precision eigensolver (dlaf_tpu extension — no LAPACK/reference
+ * counterpart): the five-stage pipeline runs in f32/c64 on the MXU and
+ * refinement recovers f64/c128 eigenpairs (full spectrum: Ogita-Aishima
+ * sweeps; a window: spectral-preconditioner sweeps at O(n^2 k) target-
+ * precision cost).  ITER through `iter` (negative = not converged);
+ * `a` is not modified. */
+int dlaf_pdsyevd_mixed(char uplo, double* a, const int desca[9], double* w,
+                       double* z, const int descz[9], int* iter);
+int dlaf_pdsyevd_mixed_partial_spectrum(char uplo, double* a,
+                                        const int desca[9], double* w,
+                                        double* z, const int descz[9],
+                                        int* iter, long il, long iu);
+int dlaf_pzheevd_mixed(char uplo, dlaf_complex_z* a, const int desca[9],
+                       double* w, dlaf_complex_z* z, const int descz[9],
+                       int* iter);
+int dlaf_pzheevd_mixed_partial_spectrum(char uplo, dlaf_complex_z* a,
+                                        const int desca[9], double* w,
+                                        dlaf_complex_z* z, const int descz[9],
+                                        int* iter, long il, long iu);
+
 /* ---- Generalized eigensolver A x = lambda B x: a holds A (uplo
  * triangle), b holds the SPD B — or its Cholesky factor for the
  * _factorized variants (reference dlaf_p*{sy,he}gvd[_factorized],

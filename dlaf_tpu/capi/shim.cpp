@@ -150,6 +150,45 @@ static int run_syevd(char uplo, void* a, const int desca[9], void* w, void* z,
   return info;
 }
 
+/* mixed-precision syevd/heevd (dlaf_tpu extension): low-precision
+ * pipeline + refinement; ITER through `iter` (negative = not converged).
+ * `a` is not modified. */
+static int run_syevd_mixed(char uplo, void* a, const int desca[9], void* w,
+                           void* z, const int descz[9], int* iter,
+                           const char* dt, long il, long iu) {
+  dlaf_tpu_init();
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* args = Py_BuildValue(
+      "(CKNKKNKsll)", (int)uplo, (unsigned long long)(uintptr_t)a,
+      desc_tuple(desca), (unsigned long long)(uintptr_t)w,
+      (unsigned long long)(uintptr_t)z, desc_tuple(descz),
+      (unsigned long long)(uintptr_t)iter, dt, il, iu);
+  int info = run_info("c_syevd_mixed", args);
+  PyGILState_Release(st);
+  return info;
+}
+int dlaf_pdsyevd_mixed(char uplo, double* a, const int desca[9], double* w,
+                       double* z, const int descz[9], int* iter) {
+  return run_syevd_mixed(uplo, a, desca, w, z, descz, iter, "f8", 0, 0);
+}
+int dlaf_pdsyevd_mixed_partial_spectrum(char uplo, double* a,
+                                        const int desca[9], double* w,
+                                        double* z, const int descz[9],
+                                        int* iter, long il, long iu) {
+  return run_syevd_mixed(uplo, a, desca, w, z, descz, iter, "f8", il, iu);
+}
+int dlaf_pzheevd_mixed(char uplo, dlaf_complex_z* a, const int desca[9],
+                       double* w, dlaf_complex_z* z, const int descz[9],
+                       int* iter) {
+  return run_syevd_mixed(uplo, a, desca, w, z, descz, iter, "c16", 0, 0);
+}
+int dlaf_pzheevd_mixed_partial_spectrum(char uplo, dlaf_complex_z* a,
+                                        const int desca[9], double* w,
+                                        dlaf_complex_z* z, const int descz[9],
+                                        int* iter, long il, long iu) {
+  return run_syevd_mixed(uplo, a, desca, w, z, descz, iter, "c16", il, iu);
+}
+
 static int run_sygvd(char uplo, void* a, const int desca[9], void* b,
                      const int descb[9], void* w, void* z, const int descz[9],
                      const char* dt, long il, long iu, int factorized) {

@@ -490,6 +490,27 @@ def pheevd(
 psyevd = pheevd  # real-symmetric alias
 
 
+def pheevd_mixed(
+    ctx: int, uplo: str, a: np.ndarray, desc: Descriptor,
+    spectrum: Optional[Tuple[int, int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Mixed-precision Hermitian eigensolver (dlaf_tpu extension): f32/c64
+    five-stage pipeline + target-precision refinement (full spectrum:
+    Ogita-Aishima sweeps; a window: spectral-preconditioner sweeps).
+    Returns ``(w, z, iter)`` — ``iter`` follows the LAPACK dsposv ITER
+    convention (sweeps when converged, negative otherwise)."""
+    from dlaf_tpu.algorithms.eig_refine import hermitian_eigensolver_mixed
+
+    res, info = hermitian_eigensolver_mixed(
+        uplo, _dist(ctx, a, desc), spectrum=spectrum
+    )
+    it = info.iters if info.converged else -(info.iters + 1)
+    return res.eigenvalues, res.eigenvectors.to_global(), it
+
+
+psyevd_mixed = pheevd_mixed  # real-symmetric alias
+
+
 def phegvd(
     ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
     b: np.ndarray, desc_b: Descriptor,

@@ -134,6 +134,30 @@ def case_scalapack_local(grid, args):
     ), np.max(np.abs(resid))
 
 
+def case_potrf_src(grid, args):
+    """Distributed Cholesky on a SOURCE-RANK matrix across processes: the
+    zero-copy origin relabeling (make_array_from_single_device_arrays over
+    per-process addressable shards) must compose with cross-process
+    collectives, and the in-place contract must hold on every rank."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    a = tu.random_hermitian_pd(args.n, np.float64, seed=43)
+    src = (1, 2)
+    mat = DistributedMatrix.from_global(grid, np.tril(a), (args.nb, args.nb),
+                                        source_rank=src)
+    fac = cholesky_factorization("L", mat)
+    assert tuple(fac.dist.source_rank) == src
+    tol = tu.tol_for(np.float64, args.n, 100.0)
+    ell = np.tril(fac.to_global())
+    assert np.max(np.abs(ell @ ell.conj().T - a)) < tol * np.abs(a).max()
+    # in-place contract on the caller's handle, in the caller's labeling
+    np.testing.assert_array_equal(np.tril(mat.to_global()), ell)
+
+
 def case_hegv(grid, args):
     """Generalized HEGV pipeline across processes (gen_to_std + HEEV +
     back-substitution), B-orthonormality checked on every rank."""
@@ -179,6 +203,7 @@ def case_heev_c128(grid, args):
 CASES = {
     "roundtrip": case_roundtrip,
     "potrf": case_potrf,
+    "potrf_src": case_potrf_src,
     "heev": case_heev,
     "hegv": case_hegv,
     "heev_c128": case_heev_c128,

@@ -224,6 +224,75 @@ def test_capi_inprocess_sposv_mixed(shim):
     lib.dlaf_free_grid(ctx)
 
 
+def test_capi_inprocess_syevd_mixed(shim):
+    """dlaf_pdsyevd_mixed (+partial): f64 eigenpairs via the f32 pipeline,
+    ITER >= 0 (converged), A unmodified, window variant consistent."""
+    lib = ctypes.CDLL(shim)
+    lib.dlaf_create_grid.restype = ctypes.c_int
+    lib.dlaf_pdsyevd_mixed.restype = ctypes.c_int
+    lib.dlaf_pdsyevd_mixed_partial_spectrum.restype = ctypes.c_int
+    ctx = lib.dlaf_create_grid(2, 2)
+    n, nb = 32, 8
+    dp = ctypes.POINTER(ctypes.c_double)
+    a = _spd(n, np.float64, seed=25)
+    wref = np.linalg.eigvalsh(a)
+    abuf = np.asfortranarray(np.tril(a))
+    a_before = abuf.copy()
+    w = np.zeros(n)
+    z = np.asfortranarray(np.zeros((n, n)))
+    it = ctypes.c_int(-999)
+    rc = lib.dlaf_pdsyevd_mixed(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        w.ctypes.data_as(dp),
+        z.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        ctypes.byref(it),
+    )
+    assert rc == 0 and it.value >= 0, it.value
+    np.testing.assert_allclose(w, wref, atol=1e-11 * max(1.0, np.abs(wref).max()))
+    assert np.abs(a @ z - z * w[None, :]).max() < 1e-10 * max(1.0, np.abs(wref).max())
+    np.testing.assert_array_equal(abuf, a_before)
+    # partial window (1-based il:iu like the other partial entries)
+    k = 10
+    wp = np.zeros(k)
+    zp = np.asfortranarray(np.zeros((n, n)))
+    itp = ctypes.c_int(-999)
+    rc = lib.dlaf_pdsyevd_mixed_partial_spectrum(
+        ctypes.c_char(b"L"),
+        abuf.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        wp.ctypes.data_as(dp),
+        zp.ctypes.data_as(dp), _desc9(ctx, n, n, nb, nb),
+        ctypes.byref(itp), ctypes.c_long(3), ctypes.c_long(12),
+    )
+    assert rc == 0 and itp.value >= 0, itp.value
+    np.testing.assert_allclose(wp, wref[2:12], atol=1e-11 * max(1.0, np.abs(wref).max()))
+    # eigenvector window: residual per column on the first k columns
+    assert np.abs(a @ zp[:, :k] - zp[:, :k] * wp[None, :]).max() < 1e-10 * max(
+        1.0, np.abs(wref).max()
+    )
+    # complex entry (zheevd_mixed): w is real f64, a/z are c128
+    lib.dlaf_pzheevd_mixed.restype = ctypes.c_int
+    rng = np.random.default_rng(26)
+    az = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    az = az @ az.conj().T + n * np.eye(n)
+    wzref = np.linalg.eigvalsh(az)
+    azbuf = np.asfortranarray(np.tril(az))
+    wz = np.zeros(n)
+    zz = np.asfortranarray(np.zeros((n, n), np.complex128))
+    itz = ctypes.c_int(-999)
+    rc = lib.dlaf_pzheevd_mixed(
+        ctypes.c_char(b"L"),
+        azbuf.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, n, nb, nb),
+        wz.ctypes.data_as(dp),
+        zz.ctypes.data_as(ctypes.c_void_p), _desc9(ctx, n, n, nb, nb),
+        ctypes.byref(itz),
+    )
+    assert rc == 0 and itz.value >= 0, itz.value
+    np.testing.assert_allclose(wz, wzref, atol=1e-10 * max(1.0, np.abs(wzref).max()))
+    assert np.abs(az @ zz - zz * wz[None, :]).max() < 1e-9 * max(1.0, np.abs(wzref).max())
+    lib.dlaf_free_grid(ctx)
+
+
 def test_capi_inprocess_partial_spectrum(shim):
     """dlaf_pdsyevd_partial_spectrum: 1-based inclusive [il, iu]
     (reference eigensolver.h:121-127 eigenvalues_index_begin/end)."""

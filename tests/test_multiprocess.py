@@ -42,6 +42,12 @@ def test_mp2_scalapack_local():
     run_world(2, 4, "scalapack_local", n=32, nb=8)
 
 
+def test_mp2_potrf_source_rank():
+    """2 processes x 4 devices: Cholesky on a source-rank matrix — the
+    zero-copy origin relabeling across process-local shards."""
+    run_world(2, 4, "potrf_src", n=32, nb=8)
+
+
 def test_mp2_hegv():
     """2 processes x 4 devices: generalized HEGV pipeline across processes
     (gen_to_std + HEEV + back-substitution, B-orthonormality per rank)."""
