@@ -52,6 +52,13 @@ from dlaf_tpu.matrix.util import _global_element_grids
 from dlaf_tpu.ops import tile as t
 
 
+# windows wider than max(WIDE_WINDOW_MIN, n/2) route to the full
+# Ogita-Aishima refinement + slice (the partial path's per-sweep k x k
+# host Rayleigh-Ritz is O(k^3)); module-level so tests can exercise the
+# route at test sizes
+WIDE_WINDOW_MIN = 512
+
+
 @dataclass
 class EigRefineInfo:
     iters: int  # refinement sweeps performed
@@ -508,14 +515,18 @@ def hermitian_eigensolver_mixed(
 
     target = np.dtype(mat_a.dtype)
     low = _lower_dtype(target, factor_dtype)
-    res_lo = hermitian_eigensolver(uplo, mat_a.astype(low))
     n = mat_a.size.rows
+    if spectrum is not None and not (0 <= spectrum[0] <= spectrum[1] < n):
+        # validate up front: BOTH routes below must reject out-of-range
+        # windows (negative starts would silently slice empty)
+        raise ValueError(f"spectrum {spectrum} outside [0, {n})")
+    res_lo = hermitian_eigensolver(uplo, mat_a.astype(low))
     # wide windows: the partial path's per-sweep k x k host RR is O(k^3),
     # so once k is a sizable fraction of n the full Ogita-Aishima sweeps
     # (all-distributed, ~4 n^3 GEMM flops/sweep) are the better tool —
     # refine fully and slice the window columns
     wide = spectrum is not None and (
-        spectrum[1] - spectrum[0] + 1 > max(512, n // 2)
+        spectrum[1] - spectrum[0] + 1 > max(WIDE_WINDOW_MIN, n // 2)
     )
     if spectrum is None or wide:
         lam, x, info = refine_eigenpairs(

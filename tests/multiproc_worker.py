@@ -121,6 +121,15 @@ def case_scalapack_local(grid, args):
         err = np.max(np.abs((slab - want) * mask)) if slab.size else 0.0
         assert err < tol * np.abs(a).max(), (rank, err)
 
+    # --- POSV: factor + solve, all slabs ---------------------------------
+    nrhs = 3
+    rhs = tu.random_matrix(n, nrhs, np.float64, seed=30)
+    desc_b = sapi.make_desc(n, nrhs, nb, nb)
+    local_rhs = sapi.global_to_local(rhs, desc_b, grid)
+    _fac2, local_x = sapi.pposv_local("L", local_a, desc, local_rhs, desc_b, grid)
+    x = sapi.matrix_from_local(local_x, desc_b, grid).to_global()
+    assert np.max(np.abs(a @ x - rhs)) < tol * np.abs(a).max()
+
     # --- HEEV: slabs in, (w, eigenvector slabs) out ----------------------
     local_w, local_v = sapi.pheevd_local("L", local_a, desc, grid)
     np.testing.assert_allclose(

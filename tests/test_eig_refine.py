@@ -125,6 +125,32 @@ def test_refine_partial_direct(grid_2x4):
     _check_partial(a, w, x.to_global(), 10, 29, 1e-11)
 
 
+def test_heev_mixed_wide_window_route(grid_2x4, monkeypatch):
+    """Windows wider than max(WIDE_WINDOW_MIN, n/2) take the full-refine +
+    slice route — same answer as the partial path, correct shapes."""
+    from dlaf_tpu.algorithms import eig_refine as er
+
+    monkeypatch.setattr(er, "WIDE_WINDOW_MIN", 8)
+
+    def _partial_forbidden(*a, **k):  # spy: the wide route must NOT come here
+        raise AssertionError("wide window took the partial path")
+
+    monkeypatch.setattr(er, "refine_partial_eigenpairs", _partial_forbidden)
+    m, nb = 64, 16
+    a = tu.random_hermitian_pd(m, np.float64, seed=51)
+    mat = DistributedMatrix.from_global(grid_2x4, np.tril(a), (nb, nb))
+    il, iu = 10, 59  # k = 50 > max(8, 32) -> wide route
+    res, info = hermitian_eigensolver_mixed("L", mat, spectrum=(il, iu))
+    assert info.converged
+    assert res.eigenvectors.size.cols == iu - il + 1
+    _check_partial(a, res.eigenvalues, res.eigenvectors.to_global(), il, iu, 1e-11)
+    # out-of-range windows are rejected on BOTH routes, before any compute
+    with pytest.raises(ValueError, match="spectrum"):
+        hermitian_eigensolver_mixed("L", mat, spectrum=(-1, 50))
+    with pytest.raises(ValueError, match="spectrum"):
+        hermitian_eigensolver_mixed("L", mat, spectrum=(0, m))
+
+
 def test_refine_partial_source_rank(grid_2x4):
     """refine_partial_eigenpairs is origin-transparent like every public
     entry: source-rank operands work and results come back correct."""

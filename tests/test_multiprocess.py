@@ -67,6 +67,13 @@ def test_mp4_potrf():
 
 
 @pytest.mark.slow
+def test_mp4_scalapack_local():
+    """4 processes x 2 devices: the distributed-buffer mode with two grid
+    ranks per process — slab ownership split four ways."""
+    run_world(4, 2, "scalapack_local", n=32, nb=8, timeout=2400)
+
+
+@pytest.mark.slow
 def test_mp4_heev():
     """4 processes x 2 devices: full HEEV pipeline (slow: 4 parallel
     pipeline compiles on one core)."""
