@@ -51,6 +51,7 @@ from dlaf_tpu.algorithms.eig_refine import (
     EigRefineInfo,
     hermitian_eigensolver_mixed,
     refine_eigenpairs,
+    refine_partial_eigenpairs,
 )
 
 __version__ = "0.5.0"
@@ -89,5 +90,6 @@ __all__ = [
     "EigRefineInfo",
     "hermitian_eigensolver_mixed",
     "refine_eigenpairs",
+    "refine_partial_eigenpairs",
     "__version__",
 ]
