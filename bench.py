@@ -216,6 +216,16 @@ class _Child:
         from dlaf_tpu.miniapp import common as _c  # noqa: F401  persistent compile cache
         import jax
 
+        # structured metrics stream (parent forwards --metrics via env so
+        # the record comes from the process that actually runs the stages)
+        self.metrics_path = os.environ.get("DLAF_BENCH_METRICS", "")
+        if self.metrics_path:
+            from dlaf_tpu.obs import metrics as om
+
+            om.enable(self.metrics_path)
+            om.emit_run_meta("bench")
+            om.emit_config()
+
         # Local-dev escape hatch: the axon sitecustomize force-registers the
         # TPU tunnel platform and only a config update overrides it.
         if os.environ.get("DLAF_BENCH_PLATFORM"):
@@ -305,6 +315,11 @@ class _Child:
                 self._note(f"posv_mixed failed: {type(e).__name__}: {e}")
         else:
             self._note(f"posv_mixed skipped: {self.t_left():.0f}s left")
+        if self.metrics_path:
+            from dlaf_tpu.obs import metrics as om
+
+            om.emit("bench", record=self.rec)
+            om.close()
         return 0
 
     def _time_posv_mixed(self, n):
@@ -419,6 +434,18 @@ def _probe_until_alive(t_start, attempts):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description="dlaf_tpu headline benchmark")
+    ap.add_argument(
+        "--metrics", default="", metavar="PATH",
+        help="write a dlaf_tpu.obs JSONL metrics stream to PATH (run "
+        "metadata, config snapshot, the staged bench record, compile "
+        "events); forwarded to the child stage runner via env",
+    )
+    args, _ = ap.parse_known_args()
+    if args.metrics:
+        os.environ["DLAF_BENCH_METRICS"] = os.path.abspath(args.metrics)
     t_start = time.perf_counter()
     attempts = []
     if not _probe_until_alive(t_start, attempts):

@@ -259,6 +259,7 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from dlaf_tpu.comm import collectives as coll
     from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
     from dlaf_tpu.matrix import colpanels as cpan
     from dlaf_tpu.matrix import layout
@@ -335,12 +336,11 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
             akey = ("apply", grid.cache_key, n_pad, kpad, b1, b2, CH, K, dt, prec)
             if akey not in _bt_cache:
                 loop = partial(_bt_chunk_loop, b1=b1, b2=b2, CH=CH)
-                sm = jax.shard_map(
+                sm = coll.shard_map_compat(
                     lambda e, qc, sb: loop(e, qc, sb),
                     mesh=mesh,
                     in_specs=(colspec, P(), P()),
                     out_specs=colspec,
-                    check_vma=False,
                 )
                 _bt_cache[akey] = jax.jit(
                     sm, out_shardings=col_sh, donate_argnums=(0,)

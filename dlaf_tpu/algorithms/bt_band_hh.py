@@ -181,6 +181,7 @@ def bt_band_to_tridiagonal_hh_dist(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from dlaf_tpu.comm import collectives as coll
     from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
     from dlaf_tpu.matrix import layout
 
@@ -221,12 +222,11 @@ def bt_band_to_tridiagonal_hh_dist(
         def loop(va, ta, of, e_loc):
             return _wy_group_loop(e_loc, va, ta, of, w, g, G, kloc)
 
-        sm = jax.shard_map(
+        sm = coll.shard_map_compat(
             loop,
             mesh=mesh,
             in_specs=(P(), P(), P(), colspec),
             out_specs=colspec,
-            check_vma=False,
         )
 
         def run(x, va, ta, of, phj):

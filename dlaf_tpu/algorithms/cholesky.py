@@ -36,10 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dlaf_tpu import obs
 from dlaf_tpu.algorithms import _spmd
 from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.common import stagetimer as st
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
 
@@ -67,16 +70,19 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
         kr, kc = k % g.pr, k % g.pc
         lkc = k // g.pc
         # 1. diagonal tile to everyone; redundant local potrf
-        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        lkk = _diag_potrf(d)
+        with _scope("chol.diag_potrf"):
+            d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+            lkk = _diag_potrf(d)
         # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
-        xc = _spmd.take_col(x, lkc, g)
-        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
-        below = (gi > k)[:, None, None]
-        cp_own = jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan))
+        with _scope("chol.panel_trsm"):
+            xc = _spmd.take_col(x, lkc, g)
+            pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+            below = (gi > k)[:, None, None]
+            cp_own = jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan))
         # 3. column panel to all rank columns; transposed row panel
-        cp = coll.psum_axis(cp_own, COL_AXIS)  # [ltr, mb, mb]
-        rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
+        with _scope("chol.panel_bcast"):
+            cp = coll.psum_axis(cp_own, COL_AXIS)  # [ltr, mb, mb]
+            rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
         # write back the factored column (pivot tile + sub-diagonal tiles)
         new_col = jnp.where(
             myc == kc,
@@ -85,7 +91,8 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
         )
         x = _spmd.put_col(x, new_col, lkc)
         # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
-        x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+        with _scope("chol.trailing_update"):
+            x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
         return x
 
     x = lax.fori_loop(0, g.mt, body, x)
@@ -107,21 +114,24 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
     def step(k, x, L, C):
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
-        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        lkk = _diag_potrf(d)
+        with _scope("chol.diag_potrf"):
+            d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+            lkk = _diag_potrf(d)
         # local window starts (first slot with gi >= k+1 / gj >= k+1)
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
         gi_w = (rs + jnp.arange(L)) * g.pr + myr
         jv = (cs + jnp.arange(C)) * g.pc + myc
         # panel trsm on the row window only
-        xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
-        below = (gi_w > k)[:, None, None]
-        cp = coll.psum_axis(
-            jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan)), COL_AXIS
-        )
-        rp = coll.transpose_panel_windowed(cp, jv, rs, g.mt)
+        with _scope("chol.panel_trsm"):
+            xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+            pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+            below = (gi_w > k)[:, None, None]
+        with _scope("chol.panel_bcast"):
+            cp = coll.psum_axis(
+                jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan)), COL_AXIS
+            )
+            rp = coll.transpose_panel_windowed(cp, jv, rs, g.mt)
         # write the factored panel (window rows) and the diagonal tile
         new_col = jnp.where(below & (myc == kc), pan, xc)
         x = lax.dynamic_update_slice(x, new_col[:, None], (rs, lkc, 0, 0))
@@ -129,9 +139,10 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
         dtile = jnp.where(mine_d, lkk, x[lkr, lkc])[None, None]
         x = lax.dynamic_update_slice(x, dtile.astype(x.dtype), (lkr, lkc, 0, 0))
         # trailing update on the window
-        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
-        return lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+        with _scope("chol.trailing_update"):
+            xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+            xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+            return lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
 
     for k0, k1 in _spmd.halving_segments(g.mt):
         L = min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1)
@@ -160,14 +171,17 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
     gj = _spmd.local_col_tiles(g, myc)
 
     def compute_panel(x, k):
-        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        lkk = _diag_potrf(d)
-        xc = _spmd.take_col(x, k // g.pc, g)
-        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
-        below = (gi > k)[:, None, None]
-        cp = coll.psum_axis(
-            jnp.where(below & (myc == k % g.pc), pan, jnp.zeros_like(pan)), COL_AXIS
-        )
+        with _scope("chol.diag_potrf"):
+            d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+            lkk = _diag_potrf(d)
+        with _scope("chol.panel_trsm"):
+            xc = _spmd.take_col(x, k // g.pc, g)
+            pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+            below = (gi > k)[:, None, None]
+        with _scope("chol.panel_bcast"):
+            cp = coll.psum_axis(
+                jnp.where(below & (myc == k % g.pc), pan, jnp.zeros_like(pan)), COL_AXIS
+            )
         return lkk, cp
 
     def write_back(x, k, lkk, cp):
@@ -184,7 +198,8 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
     def body(k, carry):
         x, lkk, cp = carry
         x = write_back(x, k, lkk, cp)
-        rp = coll.transpose_panel(cp, g.mt, g.ltc)
+        with _scope("chol.panel_bcast"):
+            rp = coll.transpose_panel(cp, g.mt, g.ltc)
         # narrow update: column k+1 only, so its panel can start immediately
         l_next = (k + 1) // g.pc
         xc1 = _spmd.take_col(x, l_next, g)
@@ -195,8 +210,9 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
         # lookahead: panel k+1 from the already-updated column
         lkk1, cp1 = compute_panel(x, k + 1)
         # bulk trailing update, column k+1 excluded (already updated)
-        rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
-        x = x - jnp.einsum("iab,jcb->ijac", cp, rp_bulk.conj())
+        with _scope("chol.trailing_update"):
+            rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
+            x = x - jnp.einsum("iab,jcb->ijac", cp, rp_bulk.conj())
         return x, lkk1, cp1
 
     lkk0, cp0 = compute_panel(x, 0)
@@ -288,15 +304,19 @@ def cholesky_factorization(
 
         maybe_dump("debug_dump_cholesky_data", "dlaf_dump_cholesky_input.npz", mat_a)
     if backend == "auto" and mat_a.grid.grid_size.count() == 1:
-        return _cholesky_single_device(uplo, mat_a)
+        with obs.stage("potrf"):
+            out = _cholesky_single_device(uplo, mat_a)
+            st.barrier(out.data)
+        return out
     if uplo == t.LOWER:
         from dlaf_tpu.tune import get_tune_parameters
 
         variant = "lookahead" if get_tune_parameters().cholesky_lookahead else "bucketed"
         from dlaf_tpu.tune import blas3_precision
 
-        with blas3_precision():
+        with obs.stage("potrf"), blas3_precision():
             data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
+            st.barrier(data)
         return mat_a._inplace(data)
     if uplo == t.UPPER:
         # A = U^H U with U = L^H: mirror the stored upper triangle to lower

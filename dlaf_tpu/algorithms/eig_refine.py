@@ -62,8 +62,12 @@ WIDE_WINDOW_MIN = 512
 @dataclass
 class EigRefineInfo:
     iters: int  # refinement sweeps performed
-    ortho_error: float  # final ||I - X^H X||_max
-    converged: bool  # ortho_error <= n * eps(target) * 50 (GEMM rounding floor)
+    ortho_error: float  # final ||I - X^H X||_max (full path; inf on partial)
+    converged: bool  # driving metric <= n * eps(target) * 50 (GEMM rounding floor)
+    # final scaled residual max|A X - X diag(theta)| / max|w| — the partial
+    # path's convergence metric (it orthonormalizes by cholqr each sweep, so
+    # ortho_error is not the quantity it drives down); inf on the full path
+    residual: float = np.inf
 
 
 @partial(jax.jit, static_argnums=(3,))
@@ -206,9 +210,12 @@ def refine_eigenpairs(
     x = evecs if np.dtype(evecs.dtype) == target else evecs.astype(target)
     info = EigRefineInfo(0, np.inf, False)
     lam_host = None
+    from dlaf_tpu import obs
     from dlaf_tpu.tune import matmul_precision
 
-    with matmul_precision("float32" if target == np.float32 else "highest"):
+    with obs.stage("eig_refine"), matmul_precision(
+        "float32" if target == np.float32 else "highest"
+    ):
         for it in range(max_iters + 1):
             ax = hermitian_multiplication(
                 t.LEFT, uplo, 1.0, mat_a, x,
@@ -392,7 +399,11 @@ def refine_partial_eigenpairs(
     prev_res = np.inf
     import scipy.linalg as sla
 
-    with matmul_precision("float32" if target == np.float32 else "highest"):
+    from dlaf_tpu import obs
+
+    with obs.stage("eig_refine/partial"), matmul_precision(
+        "float32" if target == np.float32 else "highest"
+    ):
         for it in range(max_iters + 1):
             ax = hermitian_multiplication(
                 t.LEFT, uplo, 1.0, mat_a, x,
@@ -451,7 +462,7 @@ def refine_partial_eigenpairs(
             r = ax.like(_col_scale_sub(ax.data, x.data, theta_dev, ax.dist))
             res = float(_max_abs(r.data, r.dist)) / scale
             info.iters = it
-            info.ortho_error = res  # residual-based for the partial path
+            info.residual = res  # ortho_error stays inf: cholqr re-orthonormalizes
             if res <= n * eps * 50:
                 info.converged = True
                 break

@@ -73,8 +73,9 @@ def hermitian_eigensolver(
     n = mat_a.size.rows
     band = get_band_size(nb)
     from dlaf_tpu.common import stagetimer as st
+    from dlaf_tpu import obs
 
-    with st.stage("red2band"):
+    with obs.stage("red2band"):
         band_mat, taus = reduction_to_band(mat_a, band=band)
         st.barrier(band_mat.data, taus)
     # default band stage: (optional) on-device SBR band shrink, then native
@@ -88,15 +89,15 @@ def hermitian_eigensolver(
     # — no O(N^2) host object on this path.
     from dlaf_tpu.algorithms.bt_band_hh import bt_band_to_tridiagonal_hh_dist
 
-    with st.stage("band_stage"):
+    with obs.stage("band_stage"):
         hh, tr_sbr = _band_stage_hh(band_mat, band)
     if hh is not None:
-        with st.stage("tridiag"):
+        with obs.stage("tridiag"):
             evals, v = tridiagonal_eigensolver(
                 grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
             )
             st.barrier(v.data)
-        with st.stage("bt_band"):
+        with obs.stage("bt_band"):
             # the whole back-transform chain (bt_band -> sbr -> bt_red2band)
             # is row transforms over independent columns: hand E between
             # stages COLUMN-SHARDED (ColPanels), packing back to the stacked
@@ -109,10 +110,10 @@ def hermitian_eigensolver(
         if tr_sbr is not None:
             from dlaf_tpu.algorithms.band_reduction import sbr_back_transform
 
-            with st.stage("bt_sbr"):
+            with obs.stage("bt_sbr"):
                 e = sbr_back_transform(tr_sbr, e, out_cols=True)
                 st.barrier(e.data)
-        with st.stage("bt_red2band"):
+        with obs.stage("bt_red2band"):
             e = bt_reduction_to_band(e, band_mat, taus)
             st.barrier(e.data)
         return EigResult(evals, e)
@@ -189,14 +190,15 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
     if b2 and chase_ok:
         from dlaf_tpu.algorithms.band_reduction import sbr_reduce
         from dlaf_tpu.common import stagetimer as st
+        from dlaf_tpu import obs
 
         # no explicit barriers here: sbr_reduce and the chase return HOST
         # arrays (each stages its device blocks through device_get), so the
         # stage clocks already include their device work
-        with st.stage("band_stage/sbr"):
+        with obs.stage("band_stage/sbr"):
             ab = extract_band_storage(band_mat, band)
             ab2, tr = sbr_reduce(ab, band, b2, want_q=want_q)
-        with st.stage("band_stage/chase"):
+        with obs.stage("band_stage/chase"):
             if want_q:
                 hh = band_to_tridiagonal_hh_storage(ab2, b2, dt)
                 return hh, (tr if hh is not None and tr.n_sweeps else None)
@@ -312,17 +314,18 @@ def hermitian_generalized_eigensolver(
     (reference hermitian_generalized_eigensolver_factorized,
     gen_eigensolver.h:99)."""
     from dlaf_tpu.common import stagetimer as st
+    from dlaf_tpu import obs
 
-    with st.stage("cholesky_b"):
+    with obs.stage("cholesky_b"):
         fac = mat_b if factorized else cholesky_factorization(uplo, mat_b)
         st.barrier(fac.data)
-    with st.stage("gen_to_std"):
+    with obs.stage("gen_to_std"):
         a_std = generalized_to_standard(uplo, mat_a, fac)
         a_tri = mutil.extract_triangle(a_std, uplo)
         st.barrier(a_tri.data)
     res = hermitian_eigensolver(uplo, a_tri, spectrum=spectrum)
     # back-substitute: x = L^-H y (uplo=L) / U^-1 y (uplo=U)
-    with st.stage("back_subst"):
+    with obs.stage("back_subst"):
         if uplo == t.LOWER:
             e = triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, fac, res.eigenvectors)
         else:

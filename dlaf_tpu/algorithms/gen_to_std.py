@@ -48,6 +48,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
 
@@ -72,30 +73,32 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
     def step(k, a, L, C):
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
-        lkk = _spmd.bcast_diag_tile(b, k, g, myr, myc)
-        akk = _spmd.bcast_diag_tile(a, k, g, myr, myc)
-        akk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, lkk, akk)
-        akk = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, akk)
+        with _scope("hegst.diag"):
+            lkk = _spmd.bcast_diag_tile(b, k, g, myr, myc)
+            akk = _spmd.bcast_diag_tile(a, k, g, myr, myc)
+            akk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, lkk, akk)
+            akk = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, akk)
         # window of remaining rows (first slot with gi >= k+1)
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
         gi_w = (rs + jnp.arange(L)) * g.pr + myr
         jv = (cs + jnp.arange(C)) * g.pc + myc
         below = (gi_w > k)[:, None, None]
-        xa = lax.dynamic_slice(a, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-        xl = lax.dynamic_slice(b, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xa)
-        corr = jnp.asarray(half, a.dtype) * jnp.einsum("iab,bc->iac", xl, akk)
-        pan1 = pan - corr  # the value her2k uses
-        mine_c = myc == kc
-        cp_a = coll.psum_axis(
-            jnp.where(below & mine_c, pan1, jnp.zeros_like(pan1)), COL_AXIS
-        )
-        cp_l = coll.psum_axis(
-            jnp.where(below & mine_c, xl, jnp.zeros_like(xl)), COL_AXIS
-        )
-        rp_a = coll.transpose_panel_windowed(cp_a, jv, rs, g.mt)
-        rp_l = coll.transpose_panel_windowed(cp_l, jv, rs, g.mt)
+        with _scope("hegst.panel"):
+            xa = lax.dynamic_slice(a, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+            xl = lax.dynamic_slice(b, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+            pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xa)
+            corr = jnp.asarray(half, a.dtype) * jnp.einsum("iab,bc->iac", xl, akk)
+            pan1 = pan - corr  # the value her2k uses
+            mine_c = myc == kc
+            cp_a = coll.psum_axis(
+                jnp.where(below & mine_c, pan1, jnp.zeros_like(pan1)), COL_AXIS
+            )
+            cp_l = coll.psum_axis(
+                jnp.where(below & mine_c, xl, jnp.zeros_like(xl)), COL_AXIS
+            )
+            rp_a = coll.transpose_panel_windowed(cp_a, jv, rs, g.mt)
+            rp_l = coll.transpose_panel_windowed(cp_l, jv, rs, g.mt)
         # write back the twice-corrected panel and the transformed diag tile
         pan2 = pan1 - corr
         new_col = jnp.where(below & mine_c, pan2, xa)
@@ -104,10 +107,11 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
         dtile = jnp.where(mine_d, akk, a[lkr, lkc])[None, None]
         a = lax.dynamic_update_slice(a, dtile.astype(a.dtype), (lkr, lkc, 0, 0))
         # her2k on the trailing window: A -= L_p P^H + P L_p^H
-        xs = lax.dynamic_slice(a, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        xs = xs - jnp.einsum("iab,jcb->ijac", cp_l, rp_a.conj())
-        xs = xs - jnp.einsum("iab,jcb->ijac", cp_a, rp_l.conj())
-        return lax.dynamic_update_slice(a, xs, (rs, cs, 0, 0))
+        with _scope("hegst.her2k"):
+            xs = lax.dynamic_slice(a, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+            xs = xs - jnp.einsum("iab,jcb->ijac", cp_l, rp_a.conj())
+            xs = xs - jnp.einsum("iab,jcb->ijac", cp_a, rp_l.conj())
+            return lax.dynamic_update_slice(a, xs, (rs, cs, 0, 0))
 
     for k0, k1 in _spmd.halving_segments(g.mt):
         L = min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1)

@@ -35,6 +35,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
 
@@ -91,8 +92,9 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
         k = mt - 1 - s
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
-        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        tkk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, diag, 1.0, akk, eye)
+        with _scope("trtri.diag"):
+            akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+            tkk = t.trsm(t.LEFT, t.LOWER, t.NO_TRANS, diag, 1.0, akk, eye)
         # window of rows/cols >= k+1
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
@@ -100,17 +102,19 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
         gj_w = (cs + jnp.arange(C)) * g.pc + myc
         below = (gi_w > k)[:, None, None]
         # original column k below the diagonal, to every rank column
-        xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-        cp = coll.psum_axis(
-            jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
-        )
-        rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k], j window
+        with _scope("trtri.panel_bcast"):
+            xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
+            cp = coll.psum_axis(
+                jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
+            )
+            rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k], j window
         # S[i] = sum_j inv[i,j] L[j,k] over the trailing slab (inv final there)
-        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        keep = ((gj_w > k)[None, :] & (gi_w[:, None] >= gj_w[None, :]))[:, :, None, None]
-        s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
-        s_full = coll.psum_axis(s_part, COL_AXIS)
-        newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
+        with _scope("trtri.update"):
+            xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+            keep = ((gj_w > k)[None, :] & (gi_w[:, None] >= gj_w[None, :]))[:, :, None, None]
+            s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
+            s_full = coll.psum_axis(s_part, COL_AXIS)
+            newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
         newcol = jnp.where(below & (myc == kc), newcol, xc)
         x = lax.dynamic_update_slice(x, newcol[:, None], (rs, lkc, 0, 0))
         # diagonal tile write (outside the window)
@@ -142,25 +146,28 @@ def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
         k = mt - 1 - s
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
-        akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-        tkk = t.trsm(t.LEFT, t.UPPER, t.NO_TRANS, diag, 1.0, akk, eye)
+        with _scope("trtri.diag"):
+            akk = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+            tkk = t.trsm(t.LEFT, t.UPPER, t.NO_TRANS, diag, 1.0, akk, eye)
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
         gi_w = (rs + jnp.arange(L)) * g.pr + myr
         gj_w = (cs + jnp.arange(C)) * g.pc + myc
         right = (gj_w > k)[:, None, None]
         # windowed row panel of U[k, cs:cs+C] (covers all trailing cols > k)
-        xr = lax.dynamic_slice(x, (lkr, cs, 0, 0), (1, C, g.mb, g.mb))[0]
-        rp = coll.psum_axis(
-            jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
-        )
-        # row panel U[k, v] -> windowed col panel indexed by window rows i
-        cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
-        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        keep = ((gi_w > k)[:, None] & (gi_w[:, None] <= gj_w[None, :]))[:, :, None, None]
-        s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
-        s_full = coll.psum_axis(s_part, ROW_AXIS)
-        newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
+        with _scope("trtri.panel_bcast"):
+            xr = lax.dynamic_slice(x, (lkr, cs, 0, 0), (1, C, g.mb, g.mb))[0]
+            rp = coll.psum_axis(
+                jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
+            )
+            # row panel U[k, v] -> windowed col panel indexed by window rows i
+            cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
+        with _scope("trtri.update"):
+            xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+            keep = ((gi_w > k)[:, None] & (gi_w[:, None] <= gj_w[None, :]))[:, :, None, None]
+            s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
+            s_full = coll.psum_axis(s_part, ROW_AXIS)
+            newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
         newrow = jnp.where(right & (myr == kr), newrow, xr)
         x = lax.dynamic_update_slice(x, newrow[None, :], (lkr, cs, 0, 0))
         mine_d = (myr == kr) & (myc == kc)

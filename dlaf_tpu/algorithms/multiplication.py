@@ -37,6 +37,7 @@ from dlaf_tpu.algorithms import _spmd
 from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
 # A-panel structure masks
@@ -149,9 +150,11 @@ def _summa_kernel(
     al = jnp.asarray(alpha, c.dtype)
 
     def body(k, c):
-        cp = _a_col_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltr, g_c.mt)
-        rp = _b_row_panel(b, k, g_b, myr, myc, opb, g_c.ltc, g_c.nt)
-        return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+        with _scope("summa.panel_bcast"):
+            cp = _a_col_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltr, g_c.mt)
+            rp = _b_row_panel(b, k, g_b, myr, myc, opb, g_c.ltc, g_c.nt)
+        with _scope("summa.update"):
+            return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
 
     c = lax.fori_loop(0, kt, body, c)
     return coll.relocal(c)
@@ -288,14 +291,16 @@ def _summa_right_kernel(a, b, c, g_a, g_b, g_c, opa, alpha, beta, structure, dia
     al = jnp.asarray(alpha, c.dtype)
 
     def body(k, c):
-        # col panel: B[:, k] broadcast along 'c'
-        kc = k % g_b.pc
-        bc = _spmd.take_col(b, k // g_b.pc, g_b)
-        cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
-        # row panel: op(A)[k, :] — use the col-panel machinery on the
-        # transposed problem: op(A)[k, j] = opT(op(A)^T[j, k])
-        rp = _a_row_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltc, g_c.nt)
-        return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+        with _scope("summa.panel_bcast"):
+            # col panel: B[:, k] broadcast along 'c'
+            kc = k % g_b.pc
+            bc = _spmd.take_col(b, k // g_b.pc, g_b)
+            cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
+            # row panel: op(A)[k, :] — use the col-panel machinery on the
+            # transposed problem: op(A)[k, j] = opT(op(A)^T[j, k])
+            rp = _a_row_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltc, g_c.nt)
+        with _scope("summa.update"):
+            return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
 
     c = lax.fori_loop(0, kt, body, c)
     return coll.relocal(c)
@@ -450,7 +455,8 @@ def _sub_gemm_kernel(
             s_idx = gt // pc - sB[q_idx]
             bp = jnp.take(flat, jnp.clip(q_idx * Lg + s_idx, 0, pc * Lg - 1), axis=0)
         bp = jnp.where(valid_j[:, None, None], bp, jnp.zeros_like(bp))
-        return acc + jnp.einsum("iab,jbc->ijac", ap, bp)
+        with _scope("summa.update"):
+            return acc + jnp.einsum("iab,jbc->ijac", ap, bp)
 
     acc = lax.fori_loop(
         0, Rk, body, jnp.zeros((L, Cw, g_c.mb, g_c.nb), c.dtype)

@@ -112,12 +112,11 @@ def _ring_fn(grid, dist, coord):
     if key not in _cache:
         kern = _permute_rows_kernel if coord == "rows" else _permute_cols_kernel
         stacked = P(ROW_AXIS, COL_AXIS)
-        sm = jax.shard_map(
+        sm = coll.shard_map_compat(
             partial(kern, g=g),
             mesh=grid.mesh,
             in_specs=(stacked, P()),
             out_specs=stacked,
-            check_vma=False,
         )
         _cache[key] = jax.jit(sm)
     return _cache[key]
@@ -138,7 +137,11 @@ def permute(mat: DistributedMatrix, perm, coord: str = "rows") -> DistributedMat
         or n == 0
         or tuple(mat.dist.source_rank) != (0, 0)
     ):
-        # single device, empty, or nonzero source rank (whose rank-shift
-        # algebra the ring kernel does not implement): global take under jit
+        # single device or empty: global take under jit.  The source-rank
+        # guard is defensive only — @origin_transparent re-labels nonzero
+        # source ranks onto the rolled grid before this body runs, so the
+        # ring kernel (whose index algebra assumes origin (0, 0)) always
+        # sees (0, 0); the guard stays for direct internal callers that
+        # bypass the decorator
         return mat.like(_permute_data_global(mat.data, perm, mat.dist, coord))
     return mat.like(_ring_fn(mat.grid, mat.dist, coord)(mat.data, perm))

@@ -48,6 +48,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 
 
 def _panel_block_size(nb: int) -> int:
@@ -158,17 +159,20 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int, band: int):
         kc = kt % g.pc
         lkc = kt // g.pc
         # 1. gather the band-wide panel strip to every rank (O(N band) data)
-        xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
-        xcb = lax.dynamic_slice(xc, (0, 0, co), (g.ltr, g.mb, band))
-        gat = coll.all_gather_axis(xcb, ROW_AXIS)  # [pr, ltr, mb, band]
-        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, band)
-        col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
-        pnl = col_tiles.reshape(np_, band)
+        with _scope("red2band.panel_gather"):
+            xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
+            xcb = lax.dynamic_slice(xc, (0, 0, co), (g.ltr, g.mb, band))
+            gat = coll.all_gather_axis(xcb, ROW_AXIS)  # [pr, ltr, mb, band]
+            col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, band)
+            col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
+            pnl = col_tiles.reshape(np_, band)
         start = (p + 1) * band  # first eliminated row
-        p_out, v, taus = _hh_panel(pnl, start, band, np_, g.m)
-        taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (p, 0))
+        with _scope("red2band.hh_panel"):
+            p_out, v, taus = _hh_panel(pnl, start, band, np_, g.m)
+            taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (p, 0))
         # 2. T factor (replicated)
-        tmat = _t_factor(v, taus, band)
+        with _scope("red2band.t_factor"):
+            tmat = _t_factor(v, taus, band)
         # 3. two-sided trailing update on the bucketed window (static L x C):
         # V is zero outside the trailing region, so clamped window overlap
         # contributes nothing — same safety argument as cholesky bucketing
@@ -187,23 +191,24 @@ def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int, band: int):
         vc = jnp.where(
             valid_c, jnp.take(v_tiles, jnp.clip(gj_w, 0, mt_pad - 1), axis=0), 0
         )  # [C, mb, band]
-        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
-        xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
-        xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
-        mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
-        mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
-        w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
-        # mask W2 to the trailing region (element rows >= start)
-        ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
-        w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
-        w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
-        xs = (
-            xs
-            - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
-            - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
-        )
-        x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+        with _scope("red2band.trailing_update"):
+            xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+            xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
+            xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
+            xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
+            mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
+            mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
+            w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
+            # mask W2 to the trailing region (element rows >= start)
+            ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
+            w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
+            w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
+            xs = (
+                xs
+                - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
+                - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
+            )
+            x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
         # 4. write the factored panel strip back (element rows >= start on
         # the owning tile column; start is generally NOT tile-aligned)
         p_tiles = p_out.reshape(mt_pad, g.mb, band)

@@ -26,6 +26,7 @@ from dlaf_tpu.algorithms import _spmd
 from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
 
@@ -45,13 +46,14 @@ def _trsm_left_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op, 
         k = s if forward else mt - 1 - s
         kr, kc = k % g_a.pr, k % g_a.pc
         lkr = k // g_a.pr
-        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
-        # solve tile-row k of B (batched over this rank's local cols)
-        brow = _spmd.take_row(b, lkr, g_b)
-        solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-        xr = coll.psum_axis(
-            jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-        )
+        with _scope("trsm.panel_solve"):
+            akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+            # solve tile-row k of B (batched over this rank's local cols)
+            brow = _spmd.take_row(b, lkr, g_b)
+            solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
+            xr = coll.psum_axis(
+                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
+            )
         b = _spmd.put_row(b, jnp.where(myr == kr, solved, brow), lkr)
         # panel of op(A)[i, k] for remaining rows i
         remaining = (gi > k) if forward else (gi < k)
@@ -72,7 +74,8 @@ def _trsm_left_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op, 
             cp = t.op_tile(coll.transpose_panel_rows(rp, g_a.mt, g_b.ltr), op)
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
         # B[i, :] -= op(A)[i,k] @ X[k, :]
-        return b - jnp.einsum("iab,jbc->ijac", cp, xr)
+        with _scope("trsm.update"):
+            return b - jnp.einsum("iab,jbc->ijac", cp, xr)
 
     b = lax.fori_loop(0, mt, body, b)
     return coll.relocal(b)
@@ -94,13 +97,14 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
         k = s if forward else nt - 1 - s
         kr, kc = k % g_a.pr, k % g_a.pc
         lkc = k // g_a.pc
-        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
-        # solve tile-col k of B (batched over this rank's local rows)
-        bcol = _spmd.take_col(b, lkc, g_b)
-        solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
-        xc = coll.psum_axis(
-            jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
-        )
+        with _scope("trsm.panel_solve"):
+            akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+            # solve tile-col k of B (batched over this rank's local rows)
+            bcol = _spmd.take_col(b, lkc, g_b)
+            solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
+            xc = coll.psum_axis(
+                jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
+            )
         b = _spmd.put_col(b, jnp.where(myc == kc, solved, bcol), lkc)
         # panel of op(A)[k, j] for remaining cols j
         remaining = (gj > k) if forward else (gj < k)
@@ -121,7 +125,8 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
             rp = t.op_tile(coll.transpose_panel(cp, g_a.nt, g_b.ltc), op)
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
         # B[:, j] -= X[:, k] @ op(A)[k, j]
-        return b - jnp.einsum("iab,jbc->ijac", xc, rp)
+        with _scope("trsm.update"):
+            return b - jnp.einsum("iab,jbc->ijac", xc, rp)
 
     b = lax.fori_loop(0, nt, body, b)
     return coll.relocal(b)
@@ -145,12 +150,13 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         k = s if forward else mt - 1 - s
         kr, kc = k % g_a.pr, k % g_a.pc
         lkr = k // g_a.pr
-        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
-        brow = _spmd.take_row(b, lkr, g_b)
-        solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-        xr = coll.psum_axis(
-            jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-        )
+        with _scope("trsm.panel_solve"):
+            akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+            brow = _spmd.take_row(b, lkr, g_b)
+            solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
+            xr = coll.psum_axis(
+                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
+            )
         b = _spmd.put_row(b, jnp.where(myr == kr, solved, brow), lkr)
         # remaining-rows window
         if forward:
@@ -179,9 +185,10 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             # row panel -> windowed col panel: tiles indexed by A's col j
             cp = t.op_tile(coll.transpose_panel_rows_windowed(rp, gi_w, 0, g_a.mt), op)
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
-        bs = lax.dynamic_slice(b, (rs, 0, 0, 0), (L, g_b.ltc, g_b.mb, g_b.nb))
-        bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
-        return lax.dynamic_update_slice(b, bs, (rs, 0, 0, 0))
+        with _scope("trsm.update"):
+            bs = lax.dynamic_slice(b, (rs, 0, 0, 0), (L, g_b.ltc, g_b.mb, g_b.nb))
+            bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
+            return lax.dynamic_update_slice(b, bs, (rs, 0, 0, 0))
 
     for s0, s1 in _spmd.halving_segments(mt):
         rem = mt - 1 - s0  # max remaining tiles within the segment
@@ -208,12 +215,13 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         k = s if forward else nt - 1 - s
         kr, kc = k % g_a.pr, k % g_a.pc
         lkc = k // g_a.pc
-        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
-        bcol = _spmd.take_col(b, lkc, g_b)
-        solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
-        xc = coll.psum_axis(
-            jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
-        )
+        with _scope("trsm.panel_solve"):
+            akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+            bcol = _spmd.take_col(b, lkc, g_b)
+            solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
+            xc = coll.psum_axis(
+                jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
+            )
         b = _spmd.put_col(b, jnp.where(myc == kc, solved, bcol), lkc)
         # remaining-cols window
         if forward:
@@ -242,9 +250,10 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             # col panel -> windowed row panel: tiles indexed by A's row j
             rp = t.op_tile(coll.transpose_panel_windowed(cp, gj_w, 0, g_a.nt), op)
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
-        bs = lax.dynamic_slice(b, (0, cs, 0, 0), (g_b.ltr, C, g_b.mb, g_b.nb))
-        bs = bs - jnp.einsum("iab,jbc->ijac", xc, rp)
-        return lax.dynamic_update_slice(b, bs, (0, cs, 0, 0))
+        with _scope("trsm.update"):
+            bs = lax.dynamic_slice(b, (0, cs, 0, 0), (g_b.ltr, C, g_b.mb, g_b.nb))
+            bs = bs - jnp.einsum("iab,jbc->ijac", xc, rp)
+            return lax.dynamic_update_slice(b, bs, (0, cs, 0, 0))
 
     for s0, s1 in _spmd.halving_segments(nt):
         rem = nt - 1 - s0  # max remaining tiles within the segment
@@ -285,14 +294,15 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         return t.op_tile(tile, op)
 
     def solve_row(b, k):
-        kr = k % g_a.pr
-        akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
-        brow = _spmd.take_row(b, k // g_a.pr, g_b)
-        solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-        xr = coll.psum_axis(
-            jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-        )
-        return xr
+        with _scope("trsm.panel_solve"):
+            kr = k % g_a.pr
+            akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
+            brow = _spmd.take_row(b, k // g_a.pr, g_b)
+            solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
+            xr = coll.psum_axis(
+                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
+            )
+            return xr
 
     def write_row(b, k, xr):
         lkr = k // g_a.pr
@@ -334,9 +344,10 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         b = _spmd.put_row(b, brow1, lk1)
         xr1 = solve_row(b, k1)  # lookahead: overlaps with the bulk below
         # bulk update, row k1 excluded (already updated)
-        cp = panel(k)
-        cp = jnp.where((gi == k1)[:, None, None], jnp.zeros_like(cp), cp)
-        b = b - jnp.einsum("iab,jbc->ijac", cp, xr)
+        with _scope("trsm.update"):
+            cp = panel(k)
+            cp = jnp.where((gi == k1)[:, None, None], jnp.zeros_like(cp), cp)
+            b = b - jnp.einsum("iab,jbc->ijac", cp, xr)
         return b, xr1
 
     k0 = 0 if forward else mt - 1

@@ -64,13 +64,14 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS, Grid
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.trace import scope as _scope
 
 _BOTH = (ROW_AXIS, COL_AXIS)
 
 
 def _spmd(grid, fn, in_specs, out_specs, donate=()):
-    sm = jax.shard_map(
-        fn, mesh=grid.mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    sm = coll.shard_map_compat(
+        fn, mesh=grid.mesh, in_specs=in_specs, out_specs=out_specs
     )
     return jax.jit(sm, donate_argnums=donate)
 
@@ -107,8 +108,9 @@ def _leaf_kernel(d_mod, e_pad, *, g, s0, nleaf, nloc, dt):
         tri = tri.at[ii, ii + 1].set(eL)
         return tri
 
-    tris = jax.vmap(block)(bs * s0)  # [nloc, s0, s0]
-    lamL, qL = jnp.linalg.eigh(tris)
+    with _scope("dc.leaf_eigh"):
+        tris = jax.vmap(block)(bs * s0)  # [nloc, s0, s0]
+        lamL, qL = jnp.linalg.eigh(tris)
 
     # eigenvalues -> replicated [n_pad]
     def put(i, buf):
@@ -176,9 +178,10 @@ def _params_kernel(x, lam_prev, beta, *, g, S, B, n_pad, RPD, iters, dt):
     m1 = ge_row[:, None, :, None] == r1[None, :, None, :]
     m2 = ge_row[:, None, :, None] == (r1 + 1)[None, :, None, :]
     w = m1.astype(dt) + sgn_col[None, :, None, :] * m2.astype(dt)
-    zpart = jnp.sum(x * w, axis=(0, 2))  # [ltc, nb]
-    z_loc = jnp.zeros((n_pad,), dt).at[ge_col.reshape(-1)].add(zpart.reshape(-1))
-    z = lax.psum(z_loc, _BOTH)
+    with _scope("dc.z_extract"):
+        zpart = jnp.sum(x * w, axis=(0, 2))  # [ltc, nb]
+        z_loc = jnp.zeros((n_pad,), dt).at[ge_col.reshape(-1)].add(zpart.reshape(-1))
+        z = lax.psum(z_loc, _BOTH)
 
     # --- per-block sort + deflation (all closed-form, [B, S]) --------------
     d_blk = lam_prev.reshape(B, S)

@@ -17,6 +17,11 @@ data flow and schedules independent ones concurrently.
 
 All functions assume they run inside ``shard_map`` over a mesh with axes
 ``('r', 'c')`` (see grid.ROW_AXIS/COL_AXIS).
+
+Every collective reports its payload to ``obs.comms`` at trace time (the
+``_rec`` calls) — one ``is None`` test when accounting is off, and never a
+change to the traced computation (tests/test_obs.py asserts the lowered
+HLO is byte-identical either way).
 """
 from __future__ import annotations
 
@@ -25,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.obs.comms import record as _rec
 
 
 def my_rank():
@@ -32,8 +38,16 @@ def my_rank():
     return lax.axis_index(ROW_AXIS), lax.axis_index(COL_AXIS)
 
 
+def axis_size(axis: str) -> int:
+    """Static size of a mesh axis from inside shard_map.  ``lax.axis_size``
+    only exists on newer jax; ``psum`` of a literal folds to a Python int on
+    every version."""
+    fn = getattr(lax, "axis_size", None)
+    return fn(axis) if fn is not None else lax.psum(1, axis)
+
+
 def grid_shape():
-    return lax.axis_size(ROW_AXIS), lax.axis_size(COL_AXIS)
+    return axis_size(ROW_AXIS), axis_size(COL_AXIS)
 
 
 def bcast(x, root, axis: str):
@@ -42,6 +56,7 @@ def bcast(x, root, axis: str):
 
     Implemented as a psum of root-masked data: O(log P) on ICI, no explicit
     send/recv pairing (replaces schedule_bcast_send/recv)."""
+    _rec("bcast", x, axis)
     me = lax.axis_index(axis)
     zero = jnp.zeros_like(x)
     return lax.psum(jnp.where(me == root, x, zero), axis)
@@ -53,6 +68,7 @@ def bcast2d(x, root_r, root_c):
 
 
 def psum_axis(x, axis: str):
+    _rec("psum", x, axis)
     return lax.psum(x, axis)
 
 
@@ -60,7 +76,8 @@ def shift(x, axis: str, offset: int = 1):
     """Ring shift along a grid axis: device i receives the value from device
     ``(i - offset) % P`` (replaces p2p send/recv chains; lax.ppermute rides
     ICI neighbor links)."""
-    n = lax.axis_size(axis)
+    _rec("shift", x, axis)
+    n = axis_size(axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -68,6 +85,7 @@ def shift(x, axis: str, offset: int = 1):
 def all_gather_axis(x, axis: str):
     """Gather local blocks along an axis; result has a new leading axis of
     size P ordered by axis index."""
+    _rec("all_gather", x, axis)
     return lax.all_gather(x, axis)
 
 
@@ -103,6 +121,7 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     contrib = jnp.where(
         have.reshape((ltc,) + (1,) * (cp.ndim - 1)), jnp.take(cp, src_slot, axis=0), 0
     )
+    _rec("transpose_panel", contrib, ROW_AXIS)
     return lax.psum(contrib, ROW_AXIS)
 
 
@@ -121,6 +140,7 @@ def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
     have = (jv % pr == myr) & (jv < nr_row_tiles) & (src_slot >= 0) & (src_slot < L)
     taken = jnp.take(cp, jnp.clip(src_slot, 0, L - 1), axis=0)
     contrib = jnp.where(have.reshape((C,) + (1,) * (cp.ndim - 1)), taken, 0)
+    _rec("transpose_panel", contrib, ROW_AXIS)
     return lax.psum(contrib, ROW_AXIS)
 
 
@@ -140,6 +160,7 @@ def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
     have = (iv % pc == myc) & (iv < nr_col_tiles) & (src_slot >= 0) & (src_slot < C)
     taken = jnp.take(rp, jnp.clip(src_slot, 0, C - 1), axis=0)
     contrib = jnp.where(have.reshape((W,) + (1,) * (rp.ndim - 1)), taken, 0)
+    _rec("transpose_panel", contrib, COL_AXIS)
     return lax.psum(contrib, COL_AXIS)
 
 
@@ -161,6 +182,7 @@ def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
     contrib = jnp.where(
         have.reshape((ltr,) + (1,) * (rp.ndim - 1)), jnp.take(rp, src_slot, axis=0), 0
     )
+    _rec("transpose_panel", contrib, COL_AXIS)
     return lax.psum(contrib, COL_AXIS)
 
 
@@ -173,8 +195,20 @@ def spmd(grid, fn, static_argnums=(), donate_argnums=()):
     """
     P = jax.sharding.PartitionSpec
     spec = P(ROW_AXIS, COL_AXIS)
-    sm = jax.shard_map(fn, mesh=grid.mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    sm = shard_map_compat(fn, mesh=grid.mesh, in_specs=spec, out_specs=spec)
     return jax.jit(sm, static_argnums=static_argnums, donate_argnums=donate_argnums)
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication check off, across jax versions:
+    ``jax.shard_map(check_vma=...)`` on >= 0.6, the experimental module with
+    ``check_rep=...`` before that."""
+    smap = getattr(jax, "shard_map", None)
+    if smap is not None:
+        return smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as smap
+
+    return smap(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
 def local(x):

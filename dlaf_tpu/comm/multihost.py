@@ -54,6 +54,17 @@ def initialize(
 
     import jax
 
+    # On CPU backends, cross-process computations need a host collectives
+    # implementation wired into the CPU client (jax >= 0.4.34 defaults to
+    # 'none' and compiles of multi-process programs fail with
+    # "Multiprocess computations aren't implemented on the CPU backend").
+    # Must be set BEFORE the backend comes up; harmless on TPU (the config
+    # only affects CPU client creation).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # older jax: gloo is implicit
+        pass
+
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -22,7 +22,7 @@ import numpy as np
 
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Size2D
-from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.matrix.matrix import DistributedMatrix, place
 
 
 def maybe_dump(flag_name: str, path: str, mat: DistributedMatrix) -> None:
@@ -68,29 +68,78 @@ def load_global(path: str, name: str = "a") -> np.ndarray:
         return z["data"]
 
 
+_row_fetch_cache: dict = {}
+
+
+def _row_fetch_fn(grid: Grid, shape, dtype):
+    """Jitted REPLICATED fetch of one tile-ROW stack [Pc, ltc, mb, nb] at
+    traced (rr, li) — the mirror of :func:`_row_update_fn`.  The replicated
+    out_sharding makes the gather a collective every process dispatches and
+    the result addressable everywhere, so the write path stays correct on
+    multi-process worlds (plain ``np.asarray(mat.data[...])`` would try to
+    materialize non-addressable shards there)."""
+    import jax
+    from jax import lax
+
+    key = (grid.cache_key, shape, str(np.dtype(dtype)))
+    if key not in _row_fetch_cache:
+
+        def fetch(x, rr, li):
+            z = np.int32(0)  # starts must share one integer type
+            row = lax.dynamic_slice(
+                x,
+                (rr, z, li, z, z, z),
+                (1, shape[1], 1, shape[3], shape[4], shape[5]),
+            )
+            return row[0, :, 0]
+
+        _row_fetch_cache[key] = jax.jit(
+            fetch,
+            in_shardings=(grid.stacked_sharding(), None, None),
+            out_shardings=grid.replicated_sharding(),
+        )
+    return _row_fetch_cache[key]
+
+
 def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
     """Write to an HDF5 dataset ``name`` of global shape (reference
     FileHDF5::write, matrix/hdf5.h:94-308).  Streams one tile-row slab at a
     time — a single device fetch of that row's tile stack per slab, <= mb x N
     host staging, never the full N^2; block/grid geometry is attached as
-    dataset attributes so a read can reproduce the distribution."""
+    dataset attributes so a read can reproduce the distribution.
+
+    COLLECTIVE on multi-process worlds: every process must call it (the
+    per-slab gathers are collectives); only process 0 touches the file, and
+    all processes synchronize before returning."""
     import h5py
+    import jax
 
     m, n = mat.size
     mb, nb = mat.block_size
     pr, pc = mat.dist.grid_size
     sr, sc = mat.dist.source_rank
-    with h5py.File(path, "w") as f:
-        ds = f.create_dataset(name, shape=(m, n), dtype=np.dtype(mat.dtype))
-        ds.attrs["block_size"] = tuple(mat.block_size)
-        ds.attrs["grid_size"] = tuple(mat.dist.grid_size)
-        ds.attrs["source_rank"] = (sr, sc)
+    multi = jax.process_count() > 1
+    write = jax.process_index() == 0
+    fetch = _row_fetch_fn(mat.grid, tuple(mat.data.shape), mat.dtype)
+    f = h5py.File(path, "w") if write else None
+    try:
+        if write:
+            ds = f.create_dataset(name, shape=(m, n), dtype=np.dtype(mat.dtype))
+            ds.attrs["block_size"] = tuple(mat.block_size)
+            ds.attrs["grid_size"] = tuple(mat.dist.grid_size)
+            ds.attrs["source_rank"] = (sr, sc)
         for i in range(mat.nr_tiles.rows):
             r0 = i * mb
             rows = min(mb, m - r0)
             # ONE device round-trip per tile row: the whole [Pc, ltc, mb, nb]
             # stack of owner row (i%pr + sr) % pr at slot i//pr
-            row_stack = np.asarray(mat.data[(i % pr + sr) % pr, :, i // pr])
+            # int32 indices: under x64, weak Python ints trace as s64 and the
+            # spmd partitioner's s32 offset math fails HLO verification
+            row_stack = np.asarray(
+                fetch(mat.data, np.int32((i % pr + sr) % pr), np.int32(i // pr))
+            )
+            if not write:
+                continue
             slab = np.empty((rows, n), dtype=np.dtype(mat.dtype))
             for j in range(mat.nr_tiles.cols):
                 c0 = j * nb
@@ -98,6 +147,13 @@ def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
                 t = row_stack[(j % pc + sc) % pc, j // pc]
                 slab[:, c0 : c0 + cols] = t[:rows, :cols]
             ds[r0 : r0 + rows] = slab
+    finally:
+        if f is not None:
+            f.close()
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("dlaf_tpu.matrix.io.save_hdf5")
 
 
 _row_update_cache: dict = {}
@@ -114,8 +170,9 @@ def _row_update_fn(grid: Grid, shape, dtype):
     if key not in _row_update_cache:
 
         def upd(x, row, rr, li):
+            z = np.int32(0)  # starts must share one integer type
             return lax.dynamic_update_slice(
-                x, row[None, :, None], (rr, 0, li, 0, 0, 0)
+                x, row[None, :, None], (rr, z, li, z, z, z)
             )
 
         _row_update_cache[key] = jax.jit(
@@ -181,7 +238,15 @@ def load_hdf5(
                 packed[(j % pc + src[1]) % pc, j // pc, :rows, :cols] = slab[
                     :, c0 : c0 + cols
                 ]
-            data = update(data, packed, (i % pr + src[0]) % pr, i // pr)
+            # place() (not a bare ndarray into jit): device_put inside jit
+            # dispatch only reaches addressable devices, so a raw host slab
+            # breaks on multi-process worlds where the replicated sharding
+            # spans non-addressable devices
+            row = place(packed, grid.replicated_sharding())
+            # int32 indices: see save_hdf5 — s64 starts break the partitioner
+            data = update(
+                data, row, np.int32((i % pr + src[0]) % pr), np.int32(i // pr)
+            )
     return DistributedMatrix(dist, grid, data)
 
 

@@ -140,6 +140,14 @@ def miniapp_parser(desc: str) -> argparse.ArgumentParser:
         "partial-spectrum runs, eigensolver.h:39-256)",
     )
     p.add_argument(
+        "--metrics", default="", metavar="PATH",
+        help="write a schema-versioned JSONL metrics stream to PATH: run "
+        "metadata, the tune config snapshot, per-run wall times, per-stage "
+        "breakdowns (with --stage-times), per-collective message/byte "
+        "accounting, and jit compile/cache events (summarize with "
+        "scripts/report_metrics.py; multi-process ranks merge into PATH)",
+    )
+    p.add_argument(
         "--stage-times", action="store_true",
         help="print a per-stage wall-time breakdown after each timed run "
         "(syncs at stage boundaries — slightly serializes async dispatch); "
@@ -212,6 +220,17 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
     stage_times = getattr(args, "stage_times", False)
     if stage_times:
         from dlaf_tpu.common import stagetimer
+    metrics_path = getattr(args, "metrics", "")
+    if metrics_path:
+        # enable BEFORE the warmup compiles so the jax.monitoring compile
+        # listeners see them; comms accounting likewise counts each trace
+        from dlaf_tpu.obs import comms as ocomms
+        from dlaf_tpu.obs import metrics as om
+
+        om.enable(metrics_path)
+        om.emit_run_meta(name)
+        om.emit_config()
+        ocomms.start()
     results = []
     for i in range(-args.nwarmups, args.nruns):
         mat = make_input()
@@ -232,6 +251,8 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
             else:
                 print(f"[{i}] stages: none recorded (this driver's "
                       "algorithm has no stage instrumentation)")
+            if metrics_path:
+                om.emit_stages(br, total=dt)
         if tracing:
             jax.profiler.stop_trace()
             print(f"[0] trace written to {trace_dir}")
@@ -241,6 +262,12 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
         print(f"[{i}] {name} {dt:.6f}s {gflops:.3f}GFlop/s"
               f" ({args.m}, {args.m}) ({args.mb}, {args.mb}) ({args.grid_rows}, {args.grid_cols})")
         results.append((dt, gflops))
+        if metrics_path:
+            om.emit(
+                "run", name=name, run_index=i, seconds=dt, gflops=gflops,
+                m=args.m, mb=args.mb,
+                grid=[args.grid_rows, args.grid_cols], dtype=args.type,
+            )
         if check and (args.check == "all" or (args.check == "last" and i == args.nruns - 1)):
             check(out)
             print(f"[{i}] check passed")
@@ -249,4 +276,8 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
 
             mio.save(args.output_file, out)
             print(f"[{i}] output written to {args.output_file}")
+    if metrics_path:
+        om.emit_comms(ocomms.stop())
+        om.close()
+        print(f"metrics written to {metrics_path}")
     return results

@@ -39,7 +39,18 @@ def main(argv=None):
     p.add_argument("--type", choices="sdcz", default="s")
     p.add_argument("--nreps", type=int, default=30)
     p.add_argument("--kernels", default="potrf,potrf_pallas,trsm,gemm,tfactor")
+    p.add_argument(
+        "--metrics", default="", metavar="PATH",
+        help="write per-kernel timings as a dlaf_tpu.obs JSONL stream "
+        "(one 'kernel' record per timed kernel)",
+    )
     args = p.parse_args(argv)
+    if args.metrics:
+        from dlaf_tpu.obs import metrics as om
+
+        om.enable(args.metrics)
+        om.emit_run_meta("kernel_runner")
+        om.emit_config()
     dtype = DTYPES[args.type]
     if np.dtype(dtype).itemsize == 8:
         jax.config.update("jax_enable_x64", True)
@@ -160,6 +171,15 @@ def main(argv=None):
         dt_s = _time(fn, *fargs, nreps=args.nreps)
         print(f"{name:14s} nb={nb} batch={bt} {np.dtype(dtype).name:10s} "
               f"{dt_s*1e3:9.3f} ms {flops/dt_s/1e9:10.1f} GFlop/s")
+        if args.metrics:
+            om.emit(
+                "kernel", name=name, seconds=dt_s,
+                gflops=flops / dt_s / 1e9, nb=nb, batch=bt,
+                dtype=np.dtype(dtype).name, nreps=args.nreps,
+            )
+    if args.metrics:
+        om.close()
+        print(f"metrics written to {args.metrics}")
     return 0
 
 

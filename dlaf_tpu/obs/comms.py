@@ -1,0 +1,93 @@
+"""Trace-time communication accounting for ``comm.collectives``.
+
+The reference counts MPI traffic in its communicator layer; on TPU the
+collectives are compiled into the executable, so the accounting hooks in at
+the only moment Python sees them: **trace time**.  Every public collective
+in ``comm.collectives`` calls :func:`record` with its payload operand right
+before issuing the ``lax`` collective.  While accounting is off (the
+default) that call is a single ``is None`` test — no allocation, no HLO
+difference, nothing.
+
+What a record means
+-------------------
+``record`` fires once per *trace* of each collective call site, so counts
+are per-compilation, per logical call site in the traced program:
+
+* a collective inside ``lax.fori_loop``'s body counts ONCE even though the
+  device executes it every iteration (XLA traces the body once) — multiply
+  by the trip count yourself when a loop dominates;
+* SPMD means one trace covers all devices: counts and bytes are
+  **per-device payload** figures (every device moves that much), with the
+  participant count available in the ``axis_size`` column for aggregate
+  math (e.g. ring all-gather moves ``(P-1)/P * P * nbytes`` on the wire).
+
+Byte volumes are analytic: ``prod(shape) * dtype.itemsize`` of the operand
+handed to the ``lax`` collective — the logical payload, not a model of the
+algorithm XLA picks (recursive-halving psum etc. move different wire bytes;
+the logical volume is the stable, comparable figure).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+from jax import lax
+
+# (kind, dtype, axis, axis_size) -> [call_count, payload_bytes_total]
+_acc: dict | None = None
+
+
+def start() -> None:
+    """Begin accounting; resets any previous accumulation."""
+    global _acc
+    _acc = {}
+
+
+def stop() -> dict:
+    """Stop accounting and return {(kind, dtype, axis, axis_size):
+    [count, bytes]} in first-seen order."""
+    global _acc
+    acc, _acc = _acc or {}, None
+    return acc
+
+
+def collecting() -> bool:
+    return _acc is not None
+
+
+def snapshot() -> dict:
+    """Copy of the running accumulation without stopping it."""
+    return {k: list(v) for k, v in (_acc or {}).items()}
+
+
+def record(kind: str, x, axis: str | None = None) -> None:
+    """Account one collective call site: ``x`` is the operand about to be
+    handed to the ``lax`` collective, ``axis`` its mesh axis (None for 2D /
+    axis-free ops).  Runs at trace time only; no-op unless :func:`start`."""
+    if _acc is None:
+        return
+    try:
+        size = lax.psum(1, axis) if axis is not None else 0
+    except (NameError, KeyError, ValueError):  # outside an axis context
+        size = 0
+    nbytes = math.prod(x.shape) * np.dtype(x.dtype).itemsize
+    key = (kind, np.dtype(x.dtype).name, axis or "", int(size))
+    ent = _acc.setdefault(key, [0, 0])
+    ent[0] += 1
+    ent[1] += nbytes
+
+
+def as_records(acc: dict) -> list:
+    """Render an accumulation dict into JSON-ready row dicts (one per
+    (kind, dtype, axis, axis_size) bucket)."""
+    return [
+        {
+            "collective": kind,
+            "dtype": dtype,
+            "axis": axis,
+            "axis_size": size,
+            "messages": count,
+            "bytes": nbytes,
+        }
+        for (kind, dtype, axis, size), (count, nbytes) in acc.items()
+    ]

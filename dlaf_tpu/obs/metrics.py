@@ -1,0 +1,240 @@
+"""Structured run metrics: schema-versioned JSONL event stream per run.
+
+Every enabled run leaves an audit trail: one JSON object per line, each
+carrying ``schema`` (version tag), ``kind`` (record type), ``ts`` (unix
+seconds) and ``rank`` (jax process index).  Record kinds and their
+required payload fields are the single source of truth in
+:data:`REQUIRED_FIELDS`; :func:`validate_record` enforces them (used by
+tests and by ``scripts/report_metrics.py``).
+
+Multi-process: rank 0 writes ``PATH``; rank r > 0 writes ``PATH.rank<r>``.
+``close()`` syncs the world (when ``jax.distributed`` is up) and then has
+rank 0 append every part file it can see into ``PATH`` — which merges
+fully on a shared filesystem or a single-host multi-process world (the
+test harness); on disjoint hosts the per-rank parts simply stay put next
+to each host's working directory.
+
+Off by default and free when off: :func:`emit` is one ``is None`` test.
+Compile-time records ride ``jax.monitoring`` listeners that are registered
+once on first :func:`enable` and forward only while an emitter is active.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+SCHEMA = "dlaf_tpu.obs/1"
+
+#: kind -> payload fields every record of that kind must carry.
+REQUIRED_FIELDS: dict = {
+    "run_meta": ("argv", "jax_version", "backend", "process_count", "device_count"),
+    "config": ("config",),
+    "stages": ("stages",),
+    "comms": ("rows",),
+    "run": ("name", "seconds"),
+    "kernel": ("name", "seconds"),
+    "bench": ("record",),
+    "compile": ("event", "duration_s"),
+    "compile_cache": ("event",),
+    "note": ("text",),
+}
+
+_emitter = None
+_listeners_registered = False
+
+
+class MetricsEmitter:
+    """JSONL writer bound to one output path (rank-suffixed off rank 0)."""
+
+    def __init__(self, path: str):
+        import jax
+
+        self.base_path = path
+        self.rank = jax.process_index()
+        self.nprocs = jax.process_count()
+        self.path = path if self.rank == 0 else f"{path}.rank{self.rank}"
+        self._fh = open(self.path, "w")
+
+    def emit(self, kind: str, **fields) -> None:
+        rec = {"schema": SCHEMA, "kind": kind, "ts": time.time(), "rank": self.rank}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        """Flush, world-sync, and merge rank part files into ``base_path``."""
+        if self._fh is None:
+            return
+        self._fh.close()
+        self._fh = None
+        if self.nprocs > 1:
+            try:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("dlaf_tpu.obs.metrics.close")
+            except Exception:
+                pass  # world already torn down: merge whatever is on disk
+            if self.rank == 0:
+                with open(self.base_path, "a") as out:
+                    for r in range(1, self.nprocs):
+                        part = f"{self.base_path}.rank{r}"
+                        if os.path.exists(part):
+                            with open(part) as fh:
+                                out.write(fh.read())
+                            os.remove(part)
+
+
+def _jsonable(x):
+    """Fallback serializer: numpy scalars, dtypes, paths, anything str-able."""
+    try:
+        return x.item()  # numpy scalar
+    except AttributeError:
+        return str(x)
+
+
+def enable(path: str) -> MetricsEmitter:
+    """Open the metrics stream at ``path`` (closing any previous one) and
+    hook the jax.monitoring compile listeners (idempotent)."""
+    global _emitter
+    if _emitter is not None:
+        _emitter.close()
+    _register_listeners()
+    _emitter = MetricsEmitter(path)
+    return _emitter
+
+
+def enabled() -> bool:
+    return _emitter is not None
+
+
+def get() -> MetricsEmitter | None:
+    return _emitter
+
+
+def emit(kind: str, **fields) -> None:
+    """Emit one record on the active stream; no-op when metrics are off."""
+    if _emitter is None:
+        return
+    _emitter.emit(kind, **fields)
+
+
+def close() -> None:
+    """Close (and on multi-process worlds merge) the active stream."""
+    global _emitter
+    if _emitter is None:
+        return
+    em, _emitter = _emitter, None
+    em.close()
+
+
+def _register_listeners() -> None:
+    """Forward jax.monitoring compile/cache events into the active stream.
+
+    Registered once per process — jax.monitoring has no unregister, so the
+    callbacks stay installed and gate on ``_emitter``."""
+    global _listeners_registered
+    if _listeners_registered:
+        return
+    _listeners_registered = True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if _emitter is not None and "compile" in event:
+            emit("compile", event=event, duration_s=float(duration))
+
+    def _on_event(event: str, **kw) -> None:
+        if _emitter is not None and ("cache" in event or "compile" in event):
+            emit("compile_cache", event=event)
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+
+
+# ---------------------------------------------------------------- helpers
+
+
+def emit_run_meta(name: str, **extra) -> None:
+    """The once-per-run identity record (argv, jax/backend/world facts)."""
+    if _emitter is None:
+        return
+    import jax
+
+    emit(
+        "run_meta",
+        name=name,
+        argv=list(sys.argv),
+        jax_version=jax.__version__,
+        backend=jax.default_backend(),
+        process_count=jax.process_count(),
+        device_count=jax.device_count(),
+        local_device_count=jax.local_device_count(),
+        **extra,
+    )
+
+
+def emit_config() -> None:
+    """Snapshot the live tune.py configuration (same facts print_config
+    renders as text)."""
+    if _emitter is None:
+        return
+    from dlaf_tpu import tune
+
+    emit("config", config=tune.config_snapshot())
+
+
+def emit_stages(times: dict, total: float | None = None) -> None:
+    """Stage wall-time breakdown from ``common.stagetimer`` ({name: s})."""
+    if _emitter is None or not times:
+        return
+    fields = {"stages": {k: float(v) for k, v in times.items()}}
+    if total is not None:
+        fields["total_s"] = float(total)
+    emit("stages", **fields)
+
+
+def emit_comms(acc: dict) -> None:
+    """Comms accounting rows from ``obs.comms`` (stop()/snapshot() dict)."""
+    if _emitter is None or not acc:
+        return
+    from dlaf_tpu.obs import comms
+
+    emit("comms", rows=comms.as_records(acc))
+
+
+def validate_record(rec: dict) -> None:
+    """Raise ValueError unless ``rec`` is a schema-valid metrics record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"record is not an object: {type(rec).__name__}")
+    if rec.get("schema") != SCHEMA:
+        raise ValueError(f"bad schema tag: {rec.get('schema')!r} != {SCHEMA!r}")
+    kind = rec.get("kind")
+    if kind not in REQUIRED_FIELDS:
+        raise ValueError(f"unknown record kind: {kind!r}")
+    for base in ("ts", "rank"):
+        if base not in rec:
+            raise ValueError(f"{kind} record missing base field {base!r}")
+    missing = [f for f in REQUIRED_FIELDS[kind] if f not in rec]
+    if missing:
+        raise ValueError(f"{kind} record missing fields: {missing}")
+
+
+def read_jsonl(path: str) -> list:
+    """Parse + validate a metrics file; returns the record list."""
+    out = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: not JSON: {e}") from e
+            validate_record(rec)
+            out.append(rec)
+    return out

@@ -1,0 +1,74 @@
+"""Trace annotation: named phases on profiler timelines and in compiled HLO.
+
+Two distinct mechanisms, chosen by where the name must land:
+
+``scope(name)``
+    ``jax.named_scope`` for use INSIDE traced kernel bodies.  JAX's name
+    stack does not cross a ``jit`` boundary from the outside, so a scope
+    entered around a compiled call never reaches that kernel's HLO — the
+    scopes must live in the function being traced.  Names placed this way
+    appear in the *compiled* executable's op metadata
+    (``lower(...).compile().as_text()``) and as grouping rows in
+    ``--trace`` / XProf timelines.  They never change the computation
+    (StableHLO is byte-identical with and without them only for the
+    location metadata — tests assert op-level equivalence via the
+    disabled-path HLO check in tests/test_obs.py).
+
+``phase(name)``
+    Host-level phase marker for orchestration code (the Python that calls
+    compiled kernels): a ``jax.profiler.TraceAnnotation`` so host timeline
+    slices carry the phase name, plus an append to the module phase log
+    when one is active (``start_phase_log``), which is how tests assert
+    "this run entered >= N named phases" without hardware or a profiler.
+
+Everything here is allocation-free on the off path: ``phase`` with no log
+active costs one TraceAnnotation enter/exit (nanoseconds, host-side only),
+and ``scope`` is plain ``jax.named_scope``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+# Ordered log of phase names entered while a log is active (None = off).
+_phase_log: list | None = None
+_lock = threading.Lock()
+
+
+def scope(name: str):
+    """``jax.named_scope`` alias for in-kernel phase names (see module doc:
+    must be entered inside the traced function to reach that kernel's HLO)."""
+    return jax.named_scope(name)
+
+
+def start_phase_log() -> None:
+    """Begin recording phase names entered via :func:`phase`; resets any
+    previous log."""
+    global _phase_log
+    with _lock:
+        _phase_log = []
+
+
+def stop_phase_log() -> list:
+    """Stop recording and return the ordered list of phase names entered."""
+    global _phase_log
+    with _lock:
+        log, _phase_log = _phase_log or [], None
+    return log
+
+
+def phase_log_active() -> bool:
+    return _phase_log is not None
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Host-level named phase around orchestration code (see module doc)."""
+    if _phase_log is not None:
+        with _lock:
+            if _phase_log is not None:
+                _phase_log.append(name)
+    with jax.profiler.TraceAnnotation(name):
+        yield

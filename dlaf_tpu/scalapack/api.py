@@ -255,6 +255,22 @@ def matrix_from_local(
 
     pr, pc = grid.grid_size
     work = grid.rolled(desc.isrc, desc.jsrc)
+    # validate keys UP FRONT: the per-shard callback below only fires for
+    # addressable devices, so a key this process cannot place (another
+    # rank's position, or a coordinate off the grid) would be dropped
+    # SILENTLY there — the classic BLACS mistake of handing rank (p, q)'s
+    # slab to the wrong process must raise, not vanish
+    mine = {
+        ((rr + desc.isrc) % pr, (cc + desc.jsrc) % pc)
+        for (rr, cc) in _local_ranks(work)
+    }
+    bad = sorted(k for k in local if k not in mine)
+    if bad:
+        raise ValueError(
+            f"matrix_from_local: keys {bad} are not grid positions this "
+            f"process addresses (its positions: {sorted(mine)}); pass each "
+            "rank's slabs on the process that owns that grid position"
+        )
     dist = Distribution((desc.m, desc.n), (desc.mb, desc.nb), grid.grid_size, (0, 0))
     dtype = next(iter(local.values())).dtype if local else np.float64
     packed = {}
@@ -498,7 +514,11 @@ def pheevd_mixed(
     five-stage pipeline + target-precision refinement (full spectrum:
     Ogita-Aishima sweeps; a window: spectral-preconditioner sweeps).
     Returns ``(w, z, iter)`` — ``iter`` follows the LAPACK dsposv ITER
-    convention (sweeps when converged, negative otherwise)."""
+    convention (sweeps when converged, negative otherwise).  Convergence
+    is judged on ``EigRefineInfo.ortho_error`` for the full spectrum and
+    on the separate ``EigRefineInfo.residual`` for a window (the two
+    paths drive different metrics; only ITER crosses this boundary and
+    the C ABI)."""
     from dlaf_tpu.algorithms.eig_refine import hermitian_eigensolver_mixed
 
     res, info = hermitian_eigensolver_mixed(

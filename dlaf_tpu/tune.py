@@ -175,22 +175,39 @@ def initialize(**overrides) -> TuneParameters:
     return _params.update(**overrides)
 
 
-def print_config(file=None) -> None:
-    """Dump the effective configuration (reference --dlaf:print-config,
-    src/init.cpp:377-383): every tune knob with its current value plus the
-    JAX runtime facts the knobs' auto modes key on."""
-    import sys
-
+def config_snapshot() -> dict:
+    """The effective configuration as one plain dict: every tune knob with
+    its current value plus the JAX runtime facts the knobs' auto modes key
+    on.  Single source for print_config and the obs.metrics 'config'
+    record (the JSONL snapshot must show the same truth the console
+    dump does)."""
     import jax
 
+    p = get_tune_parameters()
+    snap = {
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+    snap.update({f.name: getattr(p, f.name) for f in fields(p)})
+    return snap
+
+
+def print_config(file=None) -> None:
+    """Dump the effective configuration (reference --dlaf:print-config,
+    src/init.cpp:377-383) — the rendered form of :func:`config_snapshot`."""
+    import sys
+
     out = file or sys.stdout
+    snap = config_snapshot()
     print("dlaf_tpu configuration:", file=out)
-    print(f"  backend: {jax.default_backend()}  devices: {jax.device_count()}"
-          f"  processes: {jax.process_count()}  x64: {jax.config.jax_enable_x64}",
+    print(f"  backend: {snap['backend']}  devices: {snap['device_count']}"
+          f"  processes: {snap['process_count']}  x64: {snap['x64']}",
           file=out)
     p = get_tune_parameters()
     for f in fields(p):
-        print(f"  {f.name}: {getattr(p, f.name)}  (env DLAF_TPU_{f.name.upper()})",
+        print(f"  {f.name}: {snap[f.name]}  (env DLAF_TPU_{f.name.upper()})",
               file=out)
 
 

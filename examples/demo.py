@@ -65,7 +65,7 @@ pres, pinfo = dt.hermitian_eigensolver_mixed(
     spectrum=(0, 31),
 )
 print(
-    f"mixed partial heev (32 smallest): residual {pinfo.ortho_error:.1e} "
+    f"mixed partial heev (32 smallest): residual {pinfo.residual:.1e} "
     f"after {pinfo.iters} sweeps — target-precision work is O(n^2 k)"
 )
 

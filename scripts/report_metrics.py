@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Summarize a dlaf_tpu.obs metrics JSONL file on the terminal.
+
+Usage: python scripts/report_metrics.py out.jsonl [more.jsonl ...]
+
+Renders, per file: the run identity, the tune config snapshot (non-default
+knobs first is not attempted — the snapshot is small), per-run wall times,
+the per-stage breakdown, the per-collective message/byte accounting, and
+jit compile totals with persistent-cache hit/miss counts.  Every record is
+schema-validated on read (obs.metrics.validate_record), so a malformed or
+foreign file fails loudly instead of summarizing garbage.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+from collections import defaultdict
+
+# die quietly when piped to head & co.
+try:
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+except (AttributeError, ValueError):  # pragma: no cover - non-POSIX
+    pass
+
+# runnable as `python scripts/report_metrics.py` from a checkout (the
+# common case) without an install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.1f}{unit}" if unit != "B" else f"{int(b)}B"
+        b /= 1024
+    return f"{b:.1f}GiB"
+
+
+def summarize(path: str) -> int:
+    from dlaf_tpu.obs import metrics
+
+    recs = metrics.read_jsonl(path)
+    print(f"== {path}: {len(recs)} records ({metrics.SCHEMA})")
+    by_kind = defaultdict(list)
+    for r in recs:
+        by_kind[r["kind"]].append(r)
+
+    for r in by_kind.get("run_meta", []):
+        print(f"-- run: {r.get('name', '?')}  rank {r['rank']}  "
+              f"jax {r['jax_version']}  backend {r['backend']}  "
+              f"{r['process_count']} proc x {r.get('local_device_count', '?')} dev "
+              f"({r['device_count']} total)")
+        print(f"   argv: {' '.join(r['argv'])}")
+
+    for r in by_kind.get("config", []):
+        cfg = r["config"]
+        keys = sorted(cfg)
+        print(f"-- config ({len(keys)} knobs):")
+        line = []
+        for k in keys:
+            line.append(f"{k}={cfg[k]}")
+            if len(line) == 4:
+                print("   " + "  ".join(line))
+                line = []
+        if line:
+            print("   " + "  ".join(line))
+
+    runs = by_kind.get("run", [])
+    if runs:
+        print(f"-- runs ({len(runs)}):")
+        for r in runs:
+            gf = r.get("gflops", float("nan"))
+            print(f"   [{r.get('run_index', '?')}] {r['name']:24s} "
+                  f"{r['seconds']:10.6f}s {gf:10.3f} GFlop/s  rank {r['rank']}")
+
+    kernels = by_kind.get("kernel", [])
+    if kernels:
+        print(f"-- kernels ({len(kernels)}):")
+        for r in kernels:
+            print(f"   {r['name']:20s} {r['seconds'] * 1e3:9.3f} ms "
+                  f"{r.get('gflops', float('nan')):10.1f} GFlop/s")
+
+    for r in by_kind.get("stages", []):
+        total = r.get("total_s")
+        print(f"-- stages (rank {r['rank']}"
+              + (f", total {total:.3f}s" if total else "") + "):")
+        for name, secs in sorted(r["stages"].items(), key=lambda kv: -kv[1]):
+            pct = f" {100 * secs / total:5.1f}%" if total else ""
+            print(f"   {name:24s} {secs:10.3f}s{pct}")
+
+    comms = by_kind.get("comms", [])
+    if comms:
+        # aggregate across ranks/records: same key -> summed counts
+        agg = defaultdict(lambda: [0, 0])
+        for r in comms:
+            for row in r["rows"]:
+                k = (row["collective"], row["dtype"], row["axis"], row["axis_size"])
+                agg[k][0] += row["messages"]
+                agg[k][1] += row["bytes"]
+        print(f"-- comms ({len(agg)} collective classes, trace-time counts):")
+        print(f"   {'collective':18s} {'dtype':10s} {'axis':5s} "
+              f"{'P':>3s} {'msgs':>8s} {'payload':>10s}")
+        for (kind, dtype, axis, p), (msgs, nbytes) in sorted(agg.items()):
+            print(f"   {kind:18s} {dtype:10s} {axis or '-':5s} "
+                  f"{p:3d} {msgs:8d} {_fmt_bytes(nbytes):>10s}")
+
+    compiles = by_kind.get("compile", [])
+    if compiles:
+        tot = sum(r["duration_s"] for r in compiles)
+        print(f"-- jit compiles: {len(compiles)} events, {tot:.2f}s total")
+        slow = sorted(compiles, key=lambda r: -r["duration_s"])[:5]
+        for r in slow:
+            print(f"   {r['duration_s']:8.2f}s  {r['event']}")
+
+    cache = by_kind.get("compile_cache", [])
+    if cache:
+        counts = defaultdict(int)
+        for r in cache:
+            counts[r["event"]] += 1
+        hits = sum(n for e, n in counts.items() if "hit" in e)
+        misses = sum(n for e, n in counts.items() if "miss" in e)
+        print(f"-- compile cache: {hits} hits / {misses} misses "
+              f"({len(cache)} cache/compile events)")
+        for e, n in sorted(counts.items()):
+            print(f"   {n:6d}  {e}")
+
+    benches = by_kind.get("bench", [])
+    for r in benches:
+        rec = r["record"]
+        print(f"-- bench: {rec.get('metric', '?')} = {rec.get('value', '?')} "
+              f"{rec.get('unit', '')}  mfu={rec.get('mfu', 'n/a')}")
+        if "heev" in rec:
+            h = rec["heev"]
+            print(f"   heev: {h.get('metric', '?')} {h.get('seconds', '?')}s "
+                  f"{h.get('gflops', '?')} GFlop/s")
+
+    for r in by_kind.get("note", []):
+        print(f"-- note (rank {r['rank']}): {r['text']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    for path in argv:
+        summarize(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

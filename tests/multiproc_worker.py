@@ -209,8 +209,39 @@ def case_heev_c128(grid, args):
     assert np.max(np.abs(ortho)) < tol, np.max(np.abs(ortho))
 
 
+def case_hdf5(grid, args):
+    """HDF5 round-trip across processes: save_hdf5 is COLLECTIVE (every rank
+    dispatches the per-slab gathers, only rank 0 writes the file, internal
+    barrier before returning), then every rank streams it back through
+    load_hdf5 — whose slab placement must go through matrix.place() (a bare
+    ndarray into the jitted row update only reaches addressable devices and
+    breaks exactly here, on a multi-process world)."""
+    import os
+    import tempfile
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.comm import multihost
+    from dlaf_tpu.matrix import io as mio
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    a = tu.random_matrix(args.n, args.n, np.float64, seed=51)
+    path = os.path.join(tempfile.gettempdir(), f"dlaf_mp_hdf5_{args.nprocs}.h5")
+    mat = DistributedMatrix.from_global(grid, a, (args.nb, args.nb))
+    mio.save_hdf5(path, mat)  # collective; rank 0 does the file I/O
+    got = mio.load_hdf5(path, grid)
+    assert tuple(got.block_size) == (args.nb, args.nb)
+    np.testing.assert_array_equal(got.to_global(), a)
+    multihost_utils.sync_global_devices("multiproc_worker.case_hdf5.read")
+    if multihost.process_info()[0] == 0:
+        os.remove(path)
+
+
 CASES = {
     "roundtrip": case_roundtrip,
+    "hdf5": case_hdf5,
     "potrf": case_potrf,
     "potrf_src": case_potrf_src,
     "heev": case_heev,

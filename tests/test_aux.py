@@ -60,8 +60,10 @@ def test_permute_ring_shapes(comm_grids, coord, m, n, nb):
 
 
 def test_permute_source_rank(grid_2x4):
-    """Nonzero source rank takes the global-take fallback and must still be
-    correct (the ring kernel's index algebra assumes origin (0,0))."""
+    """Nonzero source rank must still be correct.  Post-@origin_transparent
+    the operands are re-labeled to origin (0, 0) before the kernel runs, so
+    this exercises the decorator's roll/unroll path on the ring kernel (the
+    in-body source-rank fallback is defensive, not reachable from here)."""
     rng = np.random.default_rng(9)
     a = tu.random_matrix(12, 12, np.float64, seed=9)
     perm = rng.permutation(12)

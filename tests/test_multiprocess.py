@@ -35,6 +35,13 @@ def test_mp2_heev():
     run_world(2, 4, "heev", n=21, nb=5)
 
 
+def test_mp2_hdf5():
+    """2 processes x 4 devices: rank-0 HDF5 write + all-rank streamed read —
+    the load path's slab placement must use matrix.place() (a raw host slab
+    into the jitted row update cannot reach non-addressable devices)."""
+    run_world(2, 4, "hdf5", n=24, nb=8)
+
+
 def test_mp2_scalapack_local():
     """2 processes x 4 devices: distributed-buffer ScaLAPACK mode — each
     process passes ONLY its local block-cyclic slabs and receives its local
