@@ -11,6 +11,13 @@ auxiliary}.h).
 """
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Index2D, Size2D
+from dlaf_tpu.health import (
+    ConvergenceError,
+    DistributionError,
+    DlafError,
+    NonFiniteError,
+    NotPositiveDefiniteError,
+)
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
@@ -60,6 +67,11 @@ __all__ = [
     "Grid",
     "Index2D",
     "Size2D",
+    "DlafError",
+    "NotPositiveDefiniteError",
+    "ConvergenceError",
+    "DistributionError",
+    "NonFiniteError",
     "Distribution",
     "DistributedMatrix",
     "MatrixRef",

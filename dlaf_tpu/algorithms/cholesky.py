@@ -59,20 +59,62 @@ def _diag_potrf(d):
     return t.potrf(d, lower=True)
 
 
-def _chol_L_kernel(x, g: _spmd.Geometry):
-    """shard_map-local kernel: x is [1,1,ltr,ltc,mb,mb]; returns same."""
+def _pivot_scan(d):
+    """First non-positive pivot of the Hermitian tile ``d``: int32 0 when
+    every pivot is positive, else the 1-based within-tile index of the first
+    pivot that is <= 0 or non-finite (LAPACK xPOTRF info semantics).
+
+    An in-graph unblocked right-looking sweep (same shape of masked rank-1
+    updates as ops/pallas_potrf._potrf_kernel) that carries the failure
+    index instead of the factor.  It cannot be read off ``_diag_potrf``'s
+    output: ``jnp.linalg.cholesky`` lowers to LAPACK potrf + a select that
+    NaN-fills the WHOLE factor on failure, erasing the pivot position.
+    Once a pivot fails the scale is forced to zero, freezing the trailing
+    matrix so the recorded first index stays exact."""
+    n = d.shape[-1]
+    a = jnp.tril(d) + jnp.swapaxes(jnp.tril(d, -1), -1, -2).conj()
+    r2 = lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    c2 = lax.broadcasted_iota(jnp.int32, (n, n), 1)
+
+    def body(j, carry):
+        a, bad = carry
+        dj = jnp.sum(jnp.where((r2 == j) & (c2 == j), a, 0)).real
+        ok = dj > 0  # False for NaN/Inf-poisoned pivots too
+        bad = jnp.where((bad == 0) & ~ok, j + 1, bad)
+        inv = jnp.where(ok, 1.0 / jnp.sqrt(jnp.where(ok, dj, 1.0)), 0.0)
+        col = jnp.sum(jnp.where(c2 == j, a, 0), axis=1) * inv.astype(a.dtype)
+        col = jnp.where(r2[:, 0] > j, col, 0)
+        a = a - jnp.where((r2 > j) & (c2 > j), col[:, None] * col[None, :].conj(), 0)
+        return a, bad
+
+    _, bad = lax.fori_loop(0, n, body, (a, jnp.zeros((), jnp.int32)))
+    return bad
+
+
+def _chol_L_kernel(x, g: _spmd.Geometry, want_info: bool = False):
+    """shard_map-local kernel: x is [1,1,ltr,ltc,mb,mb]; returns same — or,
+    with ``want_info``, (same, info) with ``info`` the LAPACK-style 1-based
+    first-failing-pivot index (0 = success) threaded through the fori_loop
+    carry — every rank scans the same broadcast diagonal tile, so the scalar
+    is replicated and costs zero extra collectives and zero host syncs.
+    ``want_info`` is a STATIC trace-time switch: off, no pivot scan and no
+    info carry are traced, so the plain path's HLO is unchanged."""
     x = coll.local(x)
     myr, myc = coll.my_rank()
     x = _spmd.pad_diag_identity(x, g, myr, myc)
     gi = _spmd.local_row_tiles(g, myr)
 
-    def body(k, x):
+    def body(k, carry):
+        x, info = carry if want_info else (carry, None)
         kr, kc = k % g.pr, k % g.pc
         lkc = k // g.pc
         # 1. diagonal tile to everyone; redundant local potrf
         with _scope("chol.diag_potrf"):
             d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
             lkk = _diag_potrf(d)
+            if want_info:
+                bad = _pivot_scan(d)
+                info = jnp.where((info == 0) & (bad > 0), k * g.mb + bad, info)
         # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
         with _scope("chol.panel_trsm"):
             xc = _spmd.take_col(x, lkc, g)
@@ -93,14 +135,16 @@ def _chol_L_kernel(x, g: _spmd.Geometry):
         # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
         with _scope("chol.trailing_update"):
             x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
-        return x
+        return (x, info) if want_info else x
 
-    x = lax.fori_loop(0, g.mt, body, x)
+    init = (x, jnp.zeros((), jnp.int32)) if want_info else x
+    out = lax.fori_loop(0, g.mt, body, init)
+    x, info = out if want_info else (out, None)
     x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
-    return coll.relocal(x)
+    return (coll.relocal(x), info) if want_info else coll.relocal(x)
 
 
-def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
+def _chol_L_bucketed_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     """Bucketed variant of _chol_L_kernel: the trailing update runs on a
     dynamic-sliced window of the local tile stack whose STATIC size shrinks
     by segment — restoring the reference's 'only the trailing submatrix'
@@ -111,12 +155,16 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
     myr, myc = coll.my_rank()
     x = _spmd.pad_diag_identity(x, g, myr, myc)
 
-    def step(k, x, L, C):
+    def step(k, carry, L, C):
+        x, info = carry if want_info else (carry, None)
         kr, kc = k % g.pr, k % g.pc
         lkr, lkc = k // g.pr, k // g.pc
         with _scope("chol.diag_potrf"):
             d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
             lkk = _diag_potrf(d)
+            if want_info:
+                bad = _pivot_scan(d)
+                info = jnp.where((info == 0) & (bad > 0), k * g.mb + bad, info)
         # local window starts (first slot with gi >= k+1 / gj >= k+1)
         rs = jnp.clip((k + g.pr - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(lkr.dtype)
         cs = jnp.clip((k + g.pc - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(lkr.dtype)
@@ -142,19 +190,22 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry):
         with _scope("chol.trailing_update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
             xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
-            return lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+            out = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+            return (out, info) if want_info else out
 
+    carry = (x, jnp.zeros((), jnp.int32)) if want_info else x
     for k0, k1 in _spmd.halving_segments(g.mt):
         L = min(g.ltr, (g.mt - 1 - k0 + g.pr - 1) // g.pr + 1)
         C = min(g.ltc, (g.mt - 1 - k0 + g.pc - 1) // g.pc + 1)
         L, C = max(L, 1), max(C, 1)
-        x = lax.fori_loop(k0, k1, partial(step, L=L, C=C), x)
+        carry = lax.fori_loop(k0, k1, partial(step, L=L, C=C), carry)
 
+    x, info = carry if want_info else (carry, None)
     x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
-    return coll.relocal(x)
+    return (coll.relocal(x), info) if want_info else coll.relocal(x)
 
 
-def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
+def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     """Lookahead variant (reference: next-panel tasks at high priority while
     the trailing update runs, factorization/cholesky/impl.h:171-174,280-282).
 
@@ -174,6 +225,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
         with _scope("chol.diag_potrf"):
             d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
             lkk = _diag_potrf(d)
+            bad = _pivot_scan(d) if want_info else None
         with _scope("chol.panel_trsm"):
             xc = _spmd.take_col(x, k // g.pc, g)
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
@@ -182,7 +234,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
             cp = coll.psum_axis(
                 jnp.where(below & (myc == k % g.pc), pan, jnp.zeros_like(pan)), COL_AXIS
             )
-        return lkk, cp
+        return lkk, cp, bad
 
     def write_back(x, k, lkk, cp):
         lkc = k // g.pc
@@ -196,7 +248,10 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
         return _spmd.put_col(x, new_col, lkc)
 
     def body(k, carry):
-        x, lkk, cp = carry
+        if want_info:
+            x, lkk, cp, info = carry
+        else:
+            x, lkk, cp = carry
         x = write_back(x, k, lkk, cp)
         with _scope("chol.panel_bcast"):
             rp = coll.transpose_panel(cp, g.mt, g.ltc)
@@ -208,34 +263,56 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry):
         xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
         x = _spmd.put_col(x, xc1, l_next)
         # lookahead: panel k+1 from the already-updated column
-        lkk1, cp1 = compute_panel(x, k + 1)
+        lkk1, cp1, bad1 = compute_panel(x, k + 1)
+        if want_info:
+            info = jnp.where((info == 0) & (bad1 > 0), (k + 1) * g.mb + bad1, info)
         # bulk trailing update, column k+1 excluded (already updated)
         with _scope("chol.trailing_update"):
             rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
             x = x - jnp.einsum("iab,jcb->ijac", cp, rp_bulk.conj())
-        return x, lkk1, cp1
+        return (x, lkk1, cp1, info) if want_info else (x, lkk1, cp1)
 
-    lkk0, cp0 = compute_panel(x, 0)
-    x, lkk, cp = lax.fori_loop(0, g.mt - 1, body, (x, lkk0, cp0))
+    lkk0, cp0, bad0 = compute_panel(x, 0)
+    if want_info:
+        # pivot-0 tile: global 1-based index == within-tile index
+        init = (x, lkk0, cp0, bad0)
+        x, lkk, cp, info = lax.fori_loop(0, g.mt - 1, body, init)
+    else:
+        x, lkk, cp = lax.fori_loop(0, g.mt - 1, body, (x, lkk0, cp0))
+        info = None
     x = write_back(x, g.mt - 1, lkk, cp)
     x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
-    return coll.relocal(x)
+    return (coll.relocal(x), info) if want_info else coll.relocal(x)
 
 
 _kernel_cache = {}
 
 
-def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed"):
+def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
+              want_info: bool = False):
     # only the bucketed variant bakes ratio-dependent segments
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key())
+    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(), want_info)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,
             "masked": _chol_L_kernel,
             "lookahead": _chol_L_lookahead_kernel,
         }[variant]
-        _kernel_cache[key] = coll.spmd(grid, partial(kern_fn, g=g), donate_argnums=(0,))
+        if want_info:
+            # kernels return (factor, info); the info scalar is computed
+            # identically on every rank (replicated P() output)
+            P = jax.sharding.PartitionSpec
+            _kernel_cache[key] = coll.spmd(
+                grid,
+                partial(kern_fn, g=g, want_info=True),
+                donate_argnums=(0,),
+                out_specs=(P(ROW_AXIS, COL_AXIS), P()),
+            )
+        else:
+            _kernel_cache[key] = coll.spmd(
+                grid, partial(kern_fn, g=g), donate_argnums=(0,)
+            )
     return _kernel_cache[key]
 
 
@@ -275,9 +352,53 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
         return mat_a._inplace(_local_cache[key](mat_a.data))
 
 
+def _factor_with_recovery(mat_a, g, variant, max_shift_attempts):
+    """Escalating diagonal-shift retry (opt-in near-SPD recovery): factor
+    A + shift*I with shift 0, then s0 = max(||A||_max, 1)*n*eps escalating
+    x100 per attempt, at most ``max_shift_attempts`` retries.  Returns
+    ``(data, info, shift)`` — info is the HOST int info of the LAST attempt
+    (each retry costs one host sync by construction: the decision to retry
+    depends on device data).  The kernel donates its input, so every
+    attempt feeds a fresh buffer and the caller's original survives."""
+    from dlaf_tpu import health
+    from dlaf_tpu.matrix import util as mutil
+
+    kern = _compiled(mat_a.grid, g, t.LOWER, variant, want_info=True)
+    orig = mat_a.data
+    data, info = kern(jnp.copy(orig))
+    st.barrier(data)
+    info_i = int(info)
+    if info_i == 0:
+        return data, 0, 0.0
+    eps = float(np.finfo(np.dtype(mat_a.dtype).type(0).real.dtype).eps)
+    anorm = float(jnp.max(jnp.abs(orig))) if orig.size else 1.0
+    shift = max(anorm, 1.0) * max(mat_a.size.rows, 1) * eps
+    eye = mutil.eye_like(mat_a).data
+    for attempt in range(1, max_shift_attempts + 1):
+        health.record(
+            "cholesky_shift_retry", attempt=attempt, shift=shift, info=info_i
+        )
+        data, info = kern(orig + np.dtype(mat_a.dtype).type(shift) * eye)
+        st.barrier(data)
+        info_i = int(info)
+        if info_i == 0:
+            health.record("cholesky_shift_recovered", attempt=attempt, shift=shift)
+            return data, 0, shift
+        if attempt < max_shift_attempts:
+            shift *= 100.0
+    return data, info_i, shift
+
+
 @origin_transparent
 def cholesky_factorization(
-    uplo: str, mat_a: DistributedMatrix, backend: str = "auto", _dump: bool = True
+    uplo: str,
+    mat_a: DistributedMatrix,
+    backend: str = "auto",
+    _dump: bool = True,
+    return_info: bool = False,
+    raise_on_failure: bool = False,
+    shift_recovery: bool = False,
+    max_shift_attempts: int = 3,
 ) -> DistributedMatrix:
     """Factor the Hermitian positive-definite ``mat_a``: on return the
     ``uplo`` triangle holds the Cholesky factor.  Only the ``uplo`` triangle
@@ -288,22 +409,44 @@ def cholesky_factorization(
 
     ``backend='auto'`` uses XLA's dense Cholesky on 1x1 grids and the
     distributed SPMD kernel otherwise; 'distributed' forces the kernel.
+
+    Failure reporting (LAPACK xPOTRF conventions, 1-based):
+
+    * ``return_info=True`` — returns ``(factor, info)``; ``info`` is 0 on
+      success, else the index of the first non-positive pivot (the leading
+      minor of order ``info`` is not positive definite).  Without
+      ``shift_recovery``/``raise_on_failure`` the info stays a lazy device
+      scalar — asynchrony is preserved, ``int(info)`` blocks.
+    * ``raise_on_failure=True`` — syncs and raises
+      :class:`~dlaf_tpu.health.NotPositiveDefiniteError` when info > 0.
+    * ``shift_recovery=True`` — opt-in bounded recovery for near-SPD
+      inputs: on failure, re-factor ``A + shift*I`` with an escalating
+      shift (at most ``max_shift_attempts`` retries; each health-recorded
+      with the shift used).  Implies host syncs; info/exceptions then
+      report the LAST attempt.
+
+    Info-code requests route 1x1 grids through the distributed kernel too:
+    the dense XLA fast path NaN-fills its whole factor on failure and
+    cannot name the pivot.
     """
+    from dlaf_tpu.health import DistributionError, NotPositiveDefiniteError
+
+    want_info = return_info or raise_on_failure or shift_recovery
     if mat_a.size.rows != mat_a.size.cols:
-        raise ValueError("cholesky: matrix must be square")
+        raise DistributionError("cholesky: matrix must be square")
     if mat_a.block_size.rows != mat_a.block_size.cols:
-        raise ValueError("cholesky: tiles must be square")
+        raise DistributionError("cholesky: tiles must be square")
     from dlaf_tpu.common import checks
 
     checks.assert_hermitian_heavy(mat_a, uplo)
     g = _spmd.Geometry.of(mat_a.dist)
     if g.mt == 0:
-        return mat_a
+        return (mat_a, 0) if return_info else mat_a
     if _dump:
         from dlaf_tpu.matrix.io import maybe_dump
 
         maybe_dump("debug_dump_cholesky_data", "dlaf_dump_cholesky_input.npz", mat_a)
-    if backend == "auto" and mat_a.grid.grid_size.count() == 1:
+    if backend == "auto" and mat_a.grid.grid_size.count() == 1 and not want_info:
         with obs.stage("potrf"):
             out = _cholesky_single_device(uplo, mat_a)
             st.barrier(out.data)
@@ -314,25 +457,52 @@ def cholesky_factorization(
         variant = "lookahead" if get_tune_parameters().cholesky_lookahead else "bucketed"
         from dlaf_tpu.tune import blas3_precision
 
+        shift = 0.0
         with obs.stage("potrf"), blas3_precision():
-            data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
+            if shift_recovery:
+                data, info, shift = _factor_with_recovery(
+                    mat_a, g, variant, max_shift_attempts
+                )
+            elif want_info:
+                data, info = _compiled(
+                    mat_a.grid, g, uplo, variant, want_info=True
+                )(mat_a.data)
+            else:
+                # plain path: the pre-health kernel trace, HLO unchanged
+                data = _compiled(mat_a.grid, g, uplo, variant)(mat_a.data)
+                info = 0
             st.barrier(data)
-        return mat_a._inplace(data)
+        out = mat_a._inplace(data)
+        if raise_on_failure and int(info) > 0:
+            raise NotPositiveDefiniteError(int(info), shift=shift)
+        return (out, info) if return_info else out
     if uplo == t.UPPER:
         # A = U^H U with U = L^H: mirror the stored upper triangle to lower
         # storage, run the Lower kernel, conj-transpose the factor back
         # (reference implements a native call_U mirror-image loop,
         # factorization/cholesky/impl.h:316-453; the two transposes here are
         # single all-to-alls, negligible next to the N^3/3 factorization).
+        # The mirrored matrix is conj(A) restricted to its stored triangle,
+        # with the SAME leading minors — the L-path info carries over.
         from dlaf_tpu.matrix import util as mutil
 
         low = mutil.transpose(mutil.extract_triangle(mat_a, "U"), conj=True)
-        fac = cholesky_factorization(t.LOWER, low, _dump=False)
+        res = cholesky_factorization(
+            t.LOWER,
+            low,
+            _dump=False,
+            return_info=want_info,
+            raise_on_failure=raise_on_failure,
+            shift_recovery=shift_recovery,
+            max_shift_attempts=max_shift_attempts,
+        )
+        fac, info = res if want_info else (res, None)
         u = mutil.transpose(mutil.extract_triangle(fac, "L"), conj=True)
         # keep the caller's original lower triangle untouched (LAPACK-style);
         # _inplace (not like): the docstring promises in-place semantics, and
         # the L path repoints the caller's handle — U must match
-        return mat_a._inplace(
+        out = mat_a._inplace(
             mutil.extract_triangle(mat_a, "L", k=-1).data + mutil.extract_triangle(u, "U").data
         )
-    raise ValueError(f"bad uplo {uplo}")
+        return (out, info) if return_info else out
+    raise DistributionError(f"bad uplo {uplo}")

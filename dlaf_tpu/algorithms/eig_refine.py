@@ -194,16 +194,26 @@ def refine_eigenpairs(
     evecs: DistributedMatrix,
     max_iters: int = 3,
     gap_floor: float | None = None,
+    raise_on_failure: bool = False,
 ) -> tuple[np.ndarray, DistributedMatrix, EigRefineInfo]:
     """Ogita-Aishima refinement of approximate eigenvectors ``evecs`` of the
     Hermitian ``mat_a`` (``uplo`` triangle stored) IN ``mat_a``'s precision.
     ``evecs`` must hold all n eigenvectors (the within-span correction
     cannot repair a truncated subspace).  Returns
-    ``(eigenvalues, eigenvectors, info)``; ``evecs`` is consumed."""
+    ``(eigenvalues, eigenvectors, info)``; ``evecs`` is consumed.
+
+    Non-convergence within ``max_iters`` sweeps is health-recorded; with
+    ``raise_on_failure=True`` it raises
+    :class:`~dlaf_tpu.health.ConvergenceError` carrying the
+    :class:`EigRefineInfo`."""
+    from dlaf_tpu.health import DistributionError
+
     target = np.dtype(mat_a.dtype)
     n = mat_a.size.rows
     if evecs.size.cols != n or evecs.size.rows != n:
-        raise ValueError("refine_eigenpairs needs the full square eigenvector matrix")
+        raise DistributionError(
+            "refine_eigenpairs needs the full square eigenvector matrix"
+        )
     eps = np.finfo(np.dtype(target).type(0).real.dtype).eps
     if gap_floor is None:
         gap_floor = np.sqrt(n) * eps * 100
@@ -269,6 +279,18 @@ def refine_eigenpairs(
 
         x = permute(x, order, "cols")
         lam_host = lam_host[order]
+    if not info.converged:
+        from dlaf_tpu import health
+
+        health.record(
+            "eig_refine_not_converged", iters=info.iters, ortho_error=info.ortho_error
+        )
+        if raise_on_failure:
+            raise health.ConvergenceError(
+                f"eigenpair refinement did not converge in {info.iters} sweeps "
+                f"(ortho error {info.ortho_error:.3e})",
+                info=info,
+            )
     return lam_host, x, info
 
 
@@ -333,6 +355,7 @@ def refine_partial_eigenpairs(
     w_lo: np.ndarray,
     spectrum: tuple[int, int],
     max_iters: int = 3,
+    raise_on_failure: bool = False,
 ) -> tuple[np.ndarray, DistributedMatrix, EigRefineInfo]:
     """Refine the ``spectrum=(il, iu)`` window of a LOW-precision
     eigendecomposition to ``mat_a``'s precision, touching only the k =
@@ -380,10 +403,12 @@ def refine_partial_eigenpairs(
     rdt = np.finfo(np.dtype(target).type(0).real.dtype).dtype
     eps = np.finfo(rdt).eps
     eps_lo = np.finfo(np.dtype(low).type(0).real.dtype).eps
+    from dlaf_tpu.health import DistributionError
+
     if not (0 <= il <= iu < n):
-        raise ValueError(f"spectrum {spectrum} outside [0, {n})")
+        raise DistributionError(f"spectrum {spectrum} outside [0, {n})")
     if v_lo.size.rows != n or v_lo.size.cols != n or w_lo.shape[0] != n:
-        raise ValueError("refine_partial_eigenpairs needs the full low basis")
+        raise DistributionError("refine_partial_eigenpairs needs the full low basis")
     scale = float(np.max(np.abs(w_lo))) + np.finfo(np.float32).tiny
     w_dev = jnp.asarray(np.asarray(w_lo, np.dtype(low).type(0).real.dtype))
     x = sub_matrix(v_lo, (0, il), (n, k)).astype(target)
@@ -503,6 +528,20 @@ def refine_partial_eigenpairs(
     # every exit path above leaves x Rayleigh-Ritz-rotated with theta its
     # ascending Ritz values (sla.eigh returns ascending), so no final
     # cluster pass or reorder is needed
+    if not info.converged:
+        from dlaf_tpu import health
+
+        health.record(
+            "eig_refine_partial_not_converged",
+            iters=info.iters,
+            residual=info.residual,
+        )
+        if raise_on_failure:
+            raise health.ConvergenceError(
+                f"partial eigenpair refinement did not converge in {info.iters} "
+                f"sweeps (residual {info.residual:.3e})",
+                info=info,
+            )
     return theta, x, info
 
 
@@ -513,6 +552,7 @@ def hermitian_eigensolver_mixed(
     max_iters: int = 3,
     factor_dtype=None,
     spectrum: tuple[int, int] | None = None,
+    raise_on_failure: bool = False,
 ):
     """HEEV with the five-stage pipeline in LOW precision and refinement in
     ``mat_a``'s precision.  Full spectrum uses Ogita-Aishima sweeps; a
@@ -520,9 +560,13 @@ def hermitian_eigensolver_mixed(
     refinement (:func:`refine_partial_eigenpairs` — the low pipeline still
     runs fully, since its n x n basis IS the preconditioner, but all
     target-precision work is O(n^2 k)).  ``mat_a`` is not modified.
-    Returns ``(EigResult, info)``."""
+    Returns ``(EigResult, info)``; ``raise_on_failure=True`` turns a
+    non-converged refinement into a
+    :class:`~dlaf_tpu.health.ConvergenceError` (the stall is always
+    health-recorded either way)."""
     from dlaf_tpu.algorithms.eigensolver import EigResult, hermitian_eigensolver
     from dlaf_tpu.algorithms.solver import _lower_dtype
+    from dlaf_tpu.health import DistributionError
 
     target = np.dtype(mat_a.dtype)
     low = _lower_dtype(target, factor_dtype)
@@ -530,7 +574,7 @@ def hermitian_eigensolver_mixed(
     if spectrum is not None and not (0 <= spectrum[0] <= spectrum[1] < n):
         # validate up front: BOTH routes below must reject out-of-range
         # windows (negative starts would silently slice empty)
-        raise ValueError(f"spectrum {spectrum} outside [0, {n})")
+        raise DistributionError(f"spectrum {spectrum} outside [0, {n})")
     res_lo = hermitian_eigensolver(uplo, mat_a.astype(low))
     # wide windows: the partial path's per-sweep k x k host RR is O(k^3),
     # so once k is a sizable fraction of n the full Ogita-Aishima sweeps
@@ -541,7 +585,8 @@ def hermitian_eigensolver_mixed(
     )
     if spectrum is None or wide:
         lam, x, info = refine_eigenpairs(
-            uplo, mat_a, res_lo.eigenvectors.astype(target), max_iters=max_iters
+            uplo, mat_a, res_lo.eigenvectors.astype(target), max_iters=max_iters,
+            raise_on_failure=raise_on_failure,
         )
         if spectrum is not None:
             from dlaf_tpu.matrix.util import sub_matrix
@@ -552,6 +597,6 @@ def hermitian_eigensolver_mixed(
         return EigResult(lam, x), info
     lam, x, info = refine_partial_eigenpairs(
         uplo, mat_a, res_lo.eigenvectors, res_lo.eigenvalues, spectrum,
-        max_iters=max_iters,
+        max_iters=max_iters, raise_on_failure=raise_on_failure,
     )
     return EigResult(lam, x), info

@@ -73,11 +73,16 @@ def hermitian_eigensolver(
     n = mat_a.size.rows
     band = get_band_size(nb)
     from dlaf_tpu.common import stagetimer as st
-    from dlaf_tpu import obs
+    from dlaf_tpu import health, obs
 
+    # stage-boundary NaN/Inf sentinels (health.check_finite): active only at
+    # DLAF_TPU_CHECK_LEVEL >= 2 — a plain early return below that, so the
+    # compiled pipeline stages are untouched; at level 2 they pinpoint the
+    # first stage whose output went non-finite (NonFiniteError.stage)
     with obs.stage("red2band"):
         band_mat, taus = reduction_to_band(mat_a, band=band)
         st.barrier(band_mat.data, taus)
+    health.check_finite("red2band", band_mat, taus)
     # default band stage: (optional) on-device SBR band shrink, then native
     # Householder bulge chasing (O(N^2 b_small) on host, compact reflector
     # set, no N x N Q2 anywhere) with the blocked compact-WY back-transform
@@ -92,11 +97,13 @@ def hermitian_eigensolver(
     with obs.stage("band_stage"):
         hh, tr_sbr = _band_stage_hh(band_mat, band)
     if hh is not None:
+        health.check_finite("band_stage", hh[0], hh[1])
         with obs.stage("tridiag"):
             evals, v = tridiagonal_eigensolver(
                 grid, hh[0], hh[1], nb, dtype=mat_a.dtype, spectrum=spectrum
             )
             st.barrier(v.data)
+        health.check_finite("tridiag", evals, v)
         with obs.stage("bt_band"):
             # the whole back-transform chain (bt_band -> sbr -> bt_red2band)
             # is row transforms over independent columns: hand E between
@@ -107,15 +114,18 @@ def hermitian_eigensolver(
             # which every stage accepts.)
             e = bt_band_to_tridiagonal_hh_dist(hh, v, out_cols=True)
             st.barrier(e.data)
+        health.check_finite("bt_band", e)
         if tr_sbr is not None:
             from dlaf_tpu.algorithms.band_reduction import sbr_back_transform
 
             with obs.stage("bt_sbr"):
                 e = sbr_back_transform(tr_sbr, e, out_cols=True)
                 st.barrier(e.data)
+            health.check_finite("bt_sbr", e)
         with obs.stage("bt_red2band"):
             e = bt_reduction_to_band(e, band_mat, taus)
             st.barrier(e.data)
+        health.check_finite("bt_red2band", e)
         return EigResult(evals, e)
     # fallback (native library unavailable): explicit-Q host band stage
     if n > 0:  # m == 0 lands here too, but trivially — don't warn for it

@@ -53,11 +53,28 @@ def cholesky_solver(
 
 @origin_transparent
 def positive_definite_solver(
-    uplo: str, mat_a: DistributedMatrix, mat_b: DistributedMatrix
+    uplo: str,
+    mat_a: DistributedMatrix,
+    mat_b: DistributedMatrix,
+    return_info: bool = False,
+    raise_on_failure: bool = False,
 ) -> DistributedMatrix:
     """POSV: factor the Hermitian positive-definite ``mat_a`` (in place —
     its ``uplo`` triangle holds the Cholesky factor on return) and solve
-    A X = B.  Returns the updated B."""
+    A X = B.  Returns the updated B.
+
+    ``return_info=True`` returns ``(x, info)`` with the LAPACK-style
+    1-based first-failing-pivot info from the factorization (0 = success,
+    a lazy device scalar — see ``cholesky_factorization``);
+    ``raise_on_failure=True`` raises
+    :class:`~dlaf_tpu.health.NotPositiveDefiniteError` instead of letting
+    NaNs flow into the triangular solves."""
+    if return_info or raise_on_failure:
+        fac, info = cholesky_factorization(
+            uplo, mat_a, return_info=True, raise_on_failure=raise_on_failure
+        )
+        x = cholesky_solver(uplo, fac, mat_b)
+        return (x, info) if return_info else x
     fac = cholesky_factorization(uplo, mat_a)
     return cholesky_solver(uplo, fac, mat_b)
 
@@ -92,6 +109,7 @@ def positive_definite_solver_mixed(
     factor_dtype=None,
     max_iters: int = 30,
     fallback: bool = True,
+    raise_on_failure: bool = False,
 ) -> tuple[DistributedMatrix, MixedSolveInfo]:
     """Solve A X = B to ``mat_a.dtype`` accuracy from a LOW-precision
     Cholesky factorization plus iterative refinement (LAPACK dsposv/zcposv
@@ -103,7 +121,13 @@ def positive_definite_solver_mixed(
     not met the dsposv criterion after ``max_iters`` sweeps and
     ``fallback=True``, the system is re-solved with a full-precision
     factorization (dsposv's ITER<0 path); with ``fallback=False`` the best
-    iterate is returned with ``converged=False``."""
+    iterate is returned with ``converged=False``.
+
+    A fallback is health-recorded (``mixed_solve_fallback``).  With
+    ``raise_on_failure=True`` a final non-converged solve raises
+    :class:`~dlaf_tpu.health.ConvergenceError` carrying the
+    :class:`MixedSolveInfo` instead of returning it."""
+    from dlaf_tpu import health
     target = np.dtype(mat_a.dtype)
     low = _lower_dtype(target, factor_dtype)
     n = mat_a.size.rows
@@ -138,14 +162,32 @@ def positive_definite_solver_mixed(
         x = x.like(x.data + d.data.astype(target))
 
     if not fallback:
+        health.record(
+            "mixed_solve_stalled",
+            iters=info.iters,
+            backward_error=info.backward_error,
+        )
+        if raise_on_failure:
+            raise health.ConvergenceError(
+                f"mixed-precision refinement stalled after {info.iters} sweeps "
+                f"(backward error {info.backward_error:.3e}) and fallback is off",
+                info=info,
+            )
         return x, info
     # refinement stalled (ill-conditioned beyond 1/eps(low)): full-precision
     # factorization, like dsposv's negative-ITER exit into dpotrf/dpotrs
     info.fallback = True
+    health.record("mixed_solve_fallback", iters=info.iters, factor_dtype=str(low))
     fac = cholesky_factorization(uplo, mat_a.astype(target), _dump=False)
     x = cholesky_solver(uplo, fac, mat_b.astype(target))
     r = hermitian_multiplication(t.LEFT, uplo, -1.0, mat_a, x, 1.0, mat_b.astype(target))
     rnorm, xnorm = max_norm(r), max_norm(x)
     info.backward_error = rnorm / (xnorm * float(anorm)) if xnorm else 0.0
     info.converged = rnorm <= xnorm * tol
+    if not info.converged and raise_on_failure:
+        raise health.ConvergenceError(
+            f"positive_definite_solver_mixed did not converge even after the "
+            f"full-precision fallback (backward error {info.backward_error:.3e})",
+            info=info,
+        )
     return x, info

@@ -269,25 +269,40 @@ def _dc_solve(d, e, leaf: int):
     return lam_cur[0], q_cur[0]
 
 
-def tridiag_dc(d, e, leaf: int = 32):
+def tridiag_dc(d, e, leaf: int = 32, return_info: bool = False):
     """Full eigen-decomposition of the real symmetric tridiagonal (d, e) on
     device.  Pads to a power-of-two leaf count with decoupled large diagonal
-    entries, then drops the padding."""
+    entries, then drops the padding.
+
+    ``return_info=True`` additionally returns an IN-GRAPH int32 scalar:
+    0 when every eigenpair is finite, otherwise the 1-based index of the
+    first eigenpair whose eigenvalue or eigenvector column went non-finite
+    (a secular-equation breakdown).  Computed on device with no extra host
+    sync — callers decide when to materialize it."""
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     n = d.shape[0]
     if n == 0:
-        return d, jnp.zeros((0, 0), d.dtype)
+        out = d, jnp.zeros((0, 0), d.dtype)
+        return (*out, jnp.zeros((), jnp.int32)) if return_info else out
     if n == 1:
-        return d, jnp.ones((1, 1), d.dtype)
-    leaf = min(leaf, max(2, n))
-    nleaf = -(-n // leaf)
-    nleaf_pad = 1 << (nleaf - 1).bit_length()
-    n_pad = nleaf_pad * leaf
-    big = jnp.max(jnp.abs(d)) + jnp.sum(jnp.abs(e)) + 1.0
-    pad_vals = big * (2.0 + jnp.arange(n_pad - n, dtype=d.dtype))
-    d_p = jnp.concatenate([d, pad_vals])
-    e_p = jnp.concatenate([e, jnp.zeros((n_pad - 1 - e.shape[0],), d.dtype)])
-    lam, q = _dc_solve(d_p, e_p, leaf)
-    # padding eigenvalues are the largest by construction -> first n are real
-    return lam[:n], q[:n, :n]
+        lam, q = d, jnp.ones((1, 1), d.dtype)
+    else:
+        leaf = min(leaf, max(2, n))
+        nleaf = -(-n // leaf)
+        nleaf_pad = 1 << (nleaf - 1).bit_length()
+        n_pad = nleaf_pad * leaf
+        big = jnp.max(jnp.abs(d)) + jnp.sum(jnp.abs(e)) + 1.0
+        pad_vals = big * (2.0 + jnp.arange(n_pad - n, dtype=d.dtype))
+        d_p = jnp.concatenate([d, pad_vals])
+        e_p = jnp.concatenate([e, jnp.zeros((n_pad - 1 - e.shape[0],), d.dtype)])
+        lam, q = _dc_solve(d_p, e_p, leaf)
+        # padding eigenvalues are the largest by construction -> first n are real
+        lam, q = lam[:n], q[:n, :n]
+    if not return_info:
+        return lam, q
+    ok = jnp.isfinite(lam) & jnp.all(jnp.isfinite(q), axis=0)
+    info = jnp.where(
+        jnp.all(ok), 0, jnp.argmax(~ok).astype(jnp.int32) + 1
+    ).astype(jnp.int32)
+    return lam, q, info

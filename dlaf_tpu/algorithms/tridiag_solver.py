@@ -40,6 +40,7 @@ def tridiagonal_eigensolver(
     spectrum: Optional[Tuple[int, int]] = None,
     backend: str = "dc_dist",
     return_host: bool = False,
+    raise_on_failure: bool = False,
 ) -> Tuple[np.ndarray, DistributedMatrix]:
     """Eigendecomposition of the real symmetric tridiagonal (d, e).
 
@@ -52,7 +53,13 @@ def tridiagonal_eigensolver(
 
     Backends: 'dc_dist' (default) = multi-level distributed on-device Cuppen
     D&C (tridiag_dc_dist.py); 'host' = LAPACK MRRR via scipy; 'dc' =
-    single-device on-device Cuppen D&C (tridiag_dc.py)."""
+    single-device on-device Cuppen D&C (tridiag_dc.py).
+
+    ``raise_on_failure=True`` validates the returned eigenvalues (all
+    backends return them on host anyway, so this adds no device sync) and
+    raises :class:`~dlaf_tpu.health.ConvergenceError` carrying the 1-based
+    index of the first non-finite eigenvalue — a secular-equation / MRRR
+    breakdown that would otherwise NaN-poison the back-transform."""
     n = d.shape[0]
     if n == 0:
         w = np.zeros(0, np.dtype(dtype))
@@ -66,6 +73,8 @@ def tridiagonal_eigensolver(
         w, mat = tridiag_dc_distributed(
             grid, d, e, block_size, dtype=dtype, spectrum=spectrum
         )
+        if raise_on_failure:
+            _raise_if_nonfinite(w, backend)
         if return_host:
             return w, mat.to_global().astype(np.dtype(dtype))
         return w, mat
@@ -86,7 +95,26 @@ def tridiagonal_eigensolver(
         w, v = sla.eigh_tridiagonal(d, e, select="i", select_range=(il, iu))
     v = v.astype(np.dtype(dtype))
     w = w.astype(v.real.dtype if np.dtype(dtype).kind == "c" else np.dtype(dtype))
+    if raise_on_failure:
+        _raise_if_nonfinite(w, backend)
     if return_host:
         return w, v
     mat = DistributedMatrix.from_global(grid, v, (block_size, block_size))
     return w, mat
+
+
+def _raise_if_nonfinite(w: np.ndarray, backend: str) -> None:
+    """Raise ConvergenceError with the LAPACK-style 1-based index of the
+    first non-finite eigenvalue (the w array is already on host)."""
+    finite = np.isfinite(np.asarray(w))
+    if finite.all():
+        return
+    from dlaf_tpu import health
+
+    info = int(np.argmax(~finite)) + 1
+    health.record("tridiag_nonfinite", backend=backend, info=info)
+    raise health.ConvergenceError(
+        f"tridiagonal eigensolver ({backend}) produced a non-finite "
+        f"eigenvalue at 1-based index {info}",
+        info=info,
+    )

@@ -186,16 +186,25 @@ def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
     return lax.psum(contrib, COL_AXIS)
 
 
-def spmd(grid, fn, static_argnums=(), donate_argnums=()):
+def spmd(grid, fn, static_argnums=(), donate_argnums=(), out_specs=None):
     """jit(shard_map(fn)) over the grid mesh with stacked-layout specs.
 
     ``fn`` receives each array argument as the device-local block with the
     two leading (grid) axes of size 1 — use :func:`local` / :func:`relocal`
     to strip/restore them.
+
+    ``out_specs`` overrides the output partitioning (default: the stacked
+    ``P('r', 'c')`` layout for every output).  Kernels that return
+    auxiliary rank-replicated scalars next to the matrix — e.g. the
+    Cholesky ``info`` code — pass ``(P('r', 'c'), P())``; every rank must
+    compute the identical value for a ``P()`` output.
     """
     P = jax.sharding.PartitionSpec
     spec = P(ROW_AXIS, COL_AXIS)
-    sm = shard_map_compat(fn, mesh=grid.mesh, in_specs=spec, out_specs=spec)
+    sm = shard_map_compat(
+        fn, mesh=grid.mesh, in_specs=spec,
+        out_specs=spec if out_specs is None else out_specs,
+    )
     return jax.jit(sm, static_argnums=static_argnums, donate_argnums=donate_argnums)
 
 

@@ -35,6 +35,9 @@ def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    retries: int = 0,
+    backoff_s: float = 1.0,
+    deadline_s: float | None = None,
 ) -> None:
     """Bring up the JAX distributed runtime (idempotent).
 
@@ -43,6 +46,17 @@ def initialize(
     single-process environment where autodetection finds no cluster is
     left untouched — algorithms run exactly as before.  A later EXPLICIT
     call (with a coordinator address) overrides an earlier no-op.
+
+    Pod bring-up is the one place a transient failure is EXPECTED (the
+    coordinator process races the workers; preemptible hosts restart):
+    with ``retries > 0``, a failed EXPLICIT-coordinator bring-up is
+    retried with exponential backoff (``backoff_s`` doubling each
+    attempt, capped at 30s), giving up after ``retries`` retries or when
+    ``deadline_s`` wall-clock seconds have elapsed — whichever comes
+    first.  Each retry is health-recorded (``multihost_retry``); the
+    defaults (``retries=0``) keep behavior identical to before.
+    Autodetected single-process no-ops never retry — there is nothing to
+    wait for.
     """
     global _initialized, _world_up
     explicit = coordinator_address is not None
@@ -51,6 +65,8 @@ def initialize(
         # bring-up no-op; only an explicit call may override an earlier
         # single-process NO-OP
         return
+
+    import time
 
     import jax
 
@@ -65,34 +81,56 @@ def initialize(
     except (AttributeError, ValueError):  # older jax: gloo is implicit
         pass
 
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-        _world_up = True
-    except ValueError:
-        # jax's cluster autodetection (TPU pod metadata, SLURM, GKE, the
-        # coordinator envs) found nothing and no explicit coordinator was
-        # given: a single-process world, nothing to bring up
-        if explicit:
-            raise
-    except RuntimeError:
-        # backend already initialized / double init: fine when the world is
-        # effectively single-process; otherwise the caller initialized too
-        # late (after first device use) and must hear about it
-        if not explicit and jax.process_count() == 1:
-            import warnings
-
-            warnings.warn(
-                "multihost.initialize() called after the XLA backend came "
-                "up; continuing single-process",
-                RuntimeWarning,
-                stacklevel=2,
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
             )
-        else:
-            raise
+            _world_up = True
+            break
+        except ValueError:
+            # jax's cluster autodetection (TPU pod metadata, SLURM, GKE, the
+            # coordinator envs) found nothing and no explicit coordinator was
+            # given: a single-process world, nothing to bring up
+            if explicit:
+                raise
+            break
+        except RuntimeError as exc:
+            # backend already initialized / double init: fine when the world
+            # is effectively single-process; otherwise the caller initialized
+            # too late (after first device use), or the coordinator is not up
+            # yet (connect/handshake failure — the retryable case)
+            if not explicit and jax.process_count() == 1:
+                import warnings
+
+                warnings.warn(
+                    "multihost.initialize() called after the XLA backend came "
+                    "up; continuing single-process",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                break
+            elapsed = time.monotonic() - start
+            out_of_time = deadline_s is not None and elapsed >= deadline_s
+            if not explicit or attempt >= retries or out_of_time:
+                raise
+            wait = min(backoff_s * (2.0**attempt), 30.0)
+            if deadline_s is not None:
+                wait = min(wait, max(deadline_s - elapsed, 0.0))
+            attempt += 1
+            from dlaf_tpu import health
+
+            health.record(
+                "multihost_retry",
+                attempt=attempt,
+                wait_s=wait,
+                error=str(exc)[:200],
+            )
+            time.sleep(wait)
     _initialized = True
 
 

@@ -17,22 +17,30 @@ from __future__ import annotations
 
 import os
 
-_LEVEL = None
+_LEVEL = None  # explicit set_check_level override; None -> read the env
 
 
 def check_level() -> int:
-    global _LEVEL
-    if _LEVEL is None:
-        try:
-            _LEVEL = int(os.environ.get("DLAF_TPU_CHECK_LEVEL", "1"))
-        except ValueError:
-            _LEVEL = 1
-    return _LEVEL
+    """The active check level: an explicit :func:`set_check_level` wins;
+    otherwise ``DLAF_TPU_CHECK_LEVEL`` is read LIVE on every call (a dict
+    lookup), so env changes after import — e.g. a test monkeypatch, or a
+    launcher exporting the level before spawning ranks — are picked up
+    consistently on every rank instead of freezing the first value seen."""
+    if _LEVEL is not None:
+        return _LEVEL
+    try:
+        return int(os.environ.get("DLAF_TPU_CHECK_LEVEL", "1"))
+    except ValueError:
+        return 1
 
 
-def set_check_level(level: int) -> None:
+def set_check_level(level: int | None) -> None:
+    """Override the check level for this process (``None`` reverts to the
+    environment).  On multi-process worlds call it on EVERY rank — heavy
+    checks gather device data collectively, and a rank that skips a check
+    other ranks run deadlocks the world (see assert_hermitian_heavy)."""
     global _LEVEL
-    _LEVEL = int(level)
+    _LEVEL = None if level is None else int(level)
 
 
 def _fail(kind: str, message: str, values: dict):
@@ -69,12 +77,20 @@ def assert_hermitian_heavy(mat, uplo: str = "L", tol: float = 1e-5) -> None:
     (LAPACK semantics: the other triangle is unreferenced and may hold
     anything, so full-symmetry cannot be checked).  Validates what CAN be:
     the stored triangle is finite (no NaN/Inf) and the diagonal is real for
-    complex dtypes."""
+    complex dtypes.
+
+    COLLECTIVE-SAFE BY CONSTRUCTION, and only that way: ``mat.to_global()``
+    is a replicated all-gather on multi-process grids, so at level >= 2
+    every process must dispatch this check (the level must agree across
+    ranks — use the env or call ``set_check_level`` on all ranks).  The
+    guard below enforces that any rank reaching the gather has the same
+    trigger condition (a pure function of the shared level), never
+    rank-local data."""
     if check_level() < 2:
         return
     import numpy as np
 
-    g = mat.to_global()
+    g = mat.to_global()  # collective on multi-process worlds: all ranks gather
     stored = np.tril(g) if uplo == "L" else np.triu(g)
     n_bad = int(np.count_nonzero(~np.isfinite(stored)))
     assert_heavy(

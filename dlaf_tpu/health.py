@@ -1,0 +1,150 @@
+"""Health subsystem: error taxonomy, info codes, NaN sentinels, recovery.
+
+LAPACK/ScaLAPACK report failure through ``info`` codes (the non-SPD pivot
+index from POTRF, non-convergence counts from the eigensolvers) and the
+reference guards its internals with three-level assertions
+(include/dlaf/common/assert.h).  This module is the reproduction's
+info-code half:
+
+* a structured exception taxonomy (:class:`DlafError` and subclasses)
+  replacing bare ``ValueError``/``AssertionError`` at API boundaries —
+  :class:`DistributionError` subclasses ``ValueError`` so existing
+  ``except ValueError`` callers keep working;
+* LAPACK-compatible **1-based** info-code conventions: ``info == 0`` is
+  success, ``info == k > 0`` means the leading minor of order k is not
+  positive definite (the k-th pivot failed);
+* NaN/Inf **sentinels** (:func:`check_finite`) at pipeline stage seams,
+  gated by ``DLAF_TPU_CHECK_LEVEL >= 2`` exactly like
+  ``checks.assert_heavy`` — a no-op (and zero change to any compiled
+  computation) below that level;
+* a health **event stream** (:func:`record`) feeding ``obs.metrics`` so
+  detector hits, retries, shifts and fallbacks land in the same JSONL
+  audit trail as PR 1's run metrics, plus :func:`capture_events` for
+  tests that assert a detector actually fired.
+
+Sentinels and heavy checks are collective-safe obligations: on a
+multi-process world EVERY process must reach them (they gather device
+data), the same contract as ``DistributedMatrix.to_global``.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from dlaf_tpu.obs import metrics as _om
+
+# --------------------------------------------------------------- taxonomy
+
+
+class DlafError(Exception):
+    """Base of the dlaf_tpu error taxonomy."""
+
+
+class NotPositiveDefiniteError(DlafError, ArithmeticError):
+    """A Cholesky-based driver met a non-positive pivot.
+
+    ``info`` is the LAPACK-style 1-based index of the first failing pivot
+    (the leading minor of order ``info`` is not positive definite).
+    ``shift`` is the last diagonal shift tried when bounded recovery was
+    on (0.0 when recovery was off)."""
+
+    def __init__(self, info: int, message: str | None = None, shift: float = 0.0):
+        self.info = int(info)
+        self.shift = float(shift)
+        if message is None:
+            message = (
+                f"matrix is not positive definite: the leading minor of "
+                f"order {self.info} failed (LAPACK info={self.info})"
+            )
+            if shift:
+                message += f"; last diagonal shift tried: {shift:g}"
+        super().__init__(message)
+
+
+class ConvergenceError(DlafError, RuntimeError):
+    """An iterative driver (refinement, mixed-precision solve) did not meet
+    its convergence criterion within its iteration budget.  Carries the
+    driver's info object (e.g. ``MixedSolveInfo`` / ``EigRefineInfo``)."""
+
+    def __init__(self, message: str, info=None):
+        self.info = info
+        super().__init__(message)
+
+
+class DistributionError(DlafError, ValueError):
+    """Invalid matrix/grid distribution or API misuse (bad descriptor,
+    non-square tiles, shape mismatch).  Subclasses ``ValueError`` so
+    pre-taxonomy callers catching ``ValueError`` keep working."""
+
+
+class NonFiniteError(DlafError, ArithmeticError):
+    """A stage-boundary sentinel found NaN/Inf.  ``stage`` names the first
+    pipeline stage whose output went non-finite."""
+
+    def __init__(self, stage: str, message: str | None = None):
+        self.stage = stage
+        super().__init__(
+            message
+            or f"non-finite values (NaN/Inf) first appeared after stage {stage!r}"
+        )
+
+
+# ----------------------------------------------------------- event stream
+
+_captured: list | None = None
+
+
+def record(event: str, **fields) -> None:
+    """Record one health event (detector hit, retry, shift, fallback).
+
+    Events go to the active ``obs.metrics`` stream (kind ``"health"``) when
+    one is enabled, and to the innermost :func:`capture_events` list when a
+    test is capturing.  Free when neither is active."""
+    if _captured is not None:
+        _captured.append({"event": event, **fields})
+    _om.emit("health", event=event, **fields)
+
+
+@contextmanager
+def capture_events():
+    """Collect health events into the yielded list (for tests).
+
+    Nested captures see only their own events; the outer capture resumes
+    when the inner one exits."""
+    global _captured
+    prev, _captured = _captured, []
+    try:
+        yield _captured
+    finally:
+        _captured = prev
+
+
+# --------------------------------------------------------------- sentinels
+
+
+def check_finite(stage: str, *operands) -> None:
+    """NaN/Inf sentinel at a pipeline stage boundary.
+
+    Below ``DLAF_TPU_CHECK_LEVEL`` 2 this returns immediately without
+    touching any operand — stage outputs flow through unchanged and no
+    computation is traced, so compiled driver HLO is byte-identical with
+    sentinels off (the same guarantee obs.comms makes for accounting).
+
+    At level >= 2 every operand (``DistributedMatrix`` or array) is
+    reduced with ``isfinite`` — a host sync, like every heavy check — and
+    the first non-finite operand raises :class:`NonFiniteError` naming
+    ``stage``.  Collective-safe: on multi-process grids all processes
+    must call this (all do — it sits in SPMD driver code every rank runs).
+    """
+    from dlaf_tpu.common import checks
+
+    if checks.check_level() < 2:
+        return
+    import jax.numpy as jnp
+
+    for op in operands:
+        if op is None:
+            continue
+        data = getattr(op, "data", op)
+        if not bool(jnp.all(jnp.isfinite(data))):
+            record("nonfinite", stage=stage)
+            raise NonFiniteError(stage)

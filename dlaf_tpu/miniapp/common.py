@@ -212,10 +212,16 @@ def make_grid(args) -> Grid:
     return Grid.create(Size2D(args.grid_rows, args.grid_cols))
 
 
-def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
+def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp",
+              extra_fields=None):
     """Warmup + timed runs with per-run report lines.  With ``--trace DIR``
     the first timed run is captured by the JAX profiler (host + device
-    timelines; XLA op breakdown per pipeline stage)."""
+    timelines; XLA op breakdown per pipeline stage).
+
+    ``extra_fields`` (optional thunk -> dict) is called after each timed run
+    and its entries ride along on the report line and the ``run`` metrics
+    record — drivers use it to surface solver info (refinement iterations,
+    convergence, fallbacks) next to the timing it explains."""
     trace_dir = getattr(args, "trace", "")
     stage_times = getattr(args, "stage_times", False)
     if stage_times:
@@ -259,14 +265,18 @@ def run_timed(args, make_input, run, check=None, flops_fn=None, name="miniapp"):
         if i < 0:
             continue
         gflops = (flops_fn(args) / dt / 1e9) if flops_fn else float("nan")
+        extra = dict(extra_fields()) if extra_fields else {}
+        tail = "".join(f" {k}={v}" for k, v in extra.items())
         print(f"[{i}] {name} {dt:.6f}s {gflops:.3f}GFlop/s"
-              f" ({args.m}, {args.m}) ({args.mb}, {args.mb}) ({args.grid_rows}, {args.grid_cols})")
+              f" ({args.m}, {args.m}) ({args.mb}, {args.mb}) ({args.grid_rows}, {args.grid_cols})"
+              + tail)
         results.append((dt, gflops))
         if metrics_path:
             om.emit(
                 "run", name=name, run_index=i, seconds=dt, gflops=gflops,
                 m=args.m, mb=args.mb,
                 grid=[args.grid_rows, args.grid_cols], dtype=args.type,
+                **extra,
             )
         if check and (args.check == "all" or (args.check == "last" and i == args.nruns - 1)):
             check(out)

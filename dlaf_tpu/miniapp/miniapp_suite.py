@@ -49,6 +49,7 @@ def main(argv=None):
         return lambda: DistributedMatrix.from_global(grid, a, (mb, mb))
 
     check = None
+    extra_fields = None
     if name == "trmm":
         from dlaf_tpu.algorithms.multiplication import triangular_multiplication
 
@@ -182,6 +183,10 @@ def main(argv=None):
             last[:] = [(res.eigenvalues, info)]
             return res.eigenvectors
 
+        def extra_fields():
+            info = last[0][1]
+            return {"iters": info.iters, "converged": info.converged}
+
         make, fl = dm(np.tril(herm)), lambda a: common.ops_add_mul(dtype, 4 * _n3(a) / 3, 4 * _n3(a) / 3)
 
         def check(out):
@@ -203,13 +208,25 @@ def main(argv=None):
         if mixed and np.dtype(dtype) not in (np.dtype(np.float64), np.dtype(np.complex128)):
             raise SystemExit("posv_mixed needs --type d or z (refines to f64/c128)")
         mat_a0 = dm(np.tril(herm))()  # distributed once, outside the timed loop
+        last_info = []
 
         def run(b):
             mat_a = mat_a0.astype(dtype)  # fresh device buffer: posv donates A
             if mixed:
-                x, _info = positive_definite_solver_mixed("L", mat_a, b)
+                x, info = positive_definite_solver_mixed("L", mat_a, b)
+                last_info[:] = [info]
                 return x
             return positive_definite_solver("L", mat_a, b)
+
+        if mixed:
+            def extra_fields():
+                info = last_info[0]
+                return {
+                    "iters": info.iters,
+                    "converged": info.converged,
+                    "fallback": info.fallback,
+                    "backward_error": info.backward_error,
+                }
 
         # potrf N^3/3 + two triangular solves 2 N^2 k (k = N here)
         make = dm(dense)
@@ -242,7 +259,9 @@ def main(argv=None):
     else:
         print(f"unknown miniapp {name!r}; see module docstring")
         return 1
-    return common.run_timed(args, make, run, check, fl, name=name)
+    return common.run_timed(
+        args, make, run, check, fl, name=name, extra_fields=extra_fields
+    )
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ REQUIRED_FIELDS: dict = {
     "compile": ("event", "duration_s"),
     "compile_cache": ("event",),
     "note": ("text",),
+    "health": ("event",),
 }
 
 _emitter = None

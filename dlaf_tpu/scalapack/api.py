@@ -11,6 +11,15 @@ materialized.  Routine names mirror ScaLAPACK (p?potrf, p?potri, p?trtri,
 p?trsm, p?syevd/p?heevd, p?sygvd/p?hegvd, p?gemm).
 
 The ``_s/_d/_c/_z`` type suffixes of the C API collapse into dtype dispatch.
+
+Error surface: descriptor/grid misuse raises
+:class:`~dlaf_tpu.health.DistributionError` (a ``ValueError`` subclass —
+the C API's pre-flight DLAF_descriptor checks); numerical failure follows
+ScaLAPACK's ``info`` convention — the potrf/posv family accepts
+``return_info=True`` to get the LAPACK-style 1-based first-failing-pivot
+``info`` int alongside the result (0 = success), and raises
+:class:`~dlaf_tpu.health.NotPositiveDefiniteError` with
+``raise_on_failure=True`` instead of returning NaN-poisoned output.
 """
 from __future__ import annotations
 
@@ -21,6 +30,7 @@ import numpy as np
 
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Size2D
+from dlaf_tpu.health import DistributionError
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.ops import tile as t
 
@@ -60,13 +70,13 @@ def free_grid(ctx: int) -> None:
 
 def _grid(ctx: int) -> Grid:
     if ctx not in _grids:
-        raise ValueError(f"unknown grid context {ctx}")
+        raise DistributionError(f"unknown grid context {ctx}")
     return _grids[ctx]
 
 
 def _dist(ctx: int, a: np.ndarray, desc: Descriptor) -> DistributedMatrix:
     if a.shape != (desc.m, desc.n):
-        raise ValueError(f"array {a.shape} != descriptor {(desc.m, desc.n)}")
+        raise DistributionError(f"array {a.shape} != descriptor {(desc.m, desc.n)}")
     # Nonzero isrc/jsrc (source rank of the first block): realized by rolling
     # the grid so the descriptor's source rank is mesh origin — identical
     # physical placement, and the SPMD kernels (which assume origin (0,0))
@@ -74,7 +84,7 @@ def _dist(ctx: int, a: np.ndarray, desc: Descriptor) -> DistributedMatrix:
     grid = _grid(ctx)
     pr, pc = grid.grid_size
     if not (0 <= desc.isrc < pr and 0 <= desc.jsrc < pc):
-        raise ValueError(
+        raise DistributionError(
             f"descriptor source rank ({desc.isrc}, {desc.jsrc}) outside grid {pr}x{pc}"
         )
     return DistributedMatrix.from_global(
@@ -88,7 +98,7 @@ def _check_same_source(*descs: Descriptor) -> None:
     requires operands on one CommunicatorGrid)."""
     srcs = {(d.isrc, d.jsrc) for d in descs}
     if len(srcs) > 1:
-        raise ValueError(
+        raise DistributionError(
             f"descriptors disagree on source rank (isrc, jsrc): {sorted(srcs)}; "
             "all operands of one call must share it"
         )
@@ -266,7 +276,7 @@ def matrix_from_local(
     }
     bad = sorted(k for k in local if k not in mine)
     if bad:
-        raise ValueError(
+        raise DistributionError(
             f"matrix_from_local: keys {bad} are not grid positions this "
             f"process addresses (its positions: {sorted(mine)}); pass each "
             "rank's slabs on the process that owns that grid position"
@@ -277,9 +287,9 @@ def matrix_from_local(
     for (r, c), slab in local.items():
         want = local_shape(desc, grid.grid_size, (r, c))
         if tuple(slab.shape) != want:
-            raise ValueError(f"rank ({r},{c}) slab {slab.shape} != numroc {want}")
+            raise DistributionError(f"rank ({r},{c}) slab {slab.shape} != numroc {want}")
         if slab.dtype != dtype:
-            raise ValueError(
+            raise DistributionError(
                 f"rank ({r},{c}) slab dtype {slab.dtype} != {dtype}; all "
                 "slabs of one matrix must share a dtype"
             )
@@ -291,7 +301,7 @@ def matrix_from_local(
     def cb(idx):
         rr, cc = idx[0].start or 0, idx[1].start or 0
         if (rr, cc) not in packed:
-            raise ValueError(
+            raise DistributionError(
                 f"this process's device holds grid rank "
                 f"({(rr + desc.isrc) % pr},{(cc + desc.jsrc) % pc}) but no "
                 "slab for it was passed"
@@ -319,13 +329,22 @@ def matrix_to_local(
 
 
 def ppotrf_local(
-    uplo: str, local: Dict[Tuple[int, int], np.ndarray], desc: Descriptor, grid: Grid
-) -> Dict[Tuple[int, int], np.ndarray]:
+    uplo: str, local: Dict[Tuple[int, int], np.ndarray], desc: Descriptor, grid: Grid,
+    return_info: bool = False, raise_on_failure: bool = False,
+):
     """Cholesky in distributed-buffer mode: local slabs in, local slabs of
-    the factor out (dlaf_pdpotrf with per-rank buffers)."""
+    the factor out (dlaf_pdpotrf with per-rank buffers).  ``return_info``
+    appends the ScaLAPACK-style ``info`` int (0 = success, k > 0 = leading
+    minor of order k not positive definite)."""
     from dlaf_tpu.algorithms.cholesky import cholesky_factorization
 
     mat = matrix_from_local(local, desc, grid)
+    if return_info or raise_on_failure:
+        fac, info = cholesky_factorization(
+            uplo, mat, return_info=True, raise_on_failure=raise_on_failure
+        )
+        out = matrix_to_local(fac, desc)
+        return (out, int(info)) if return_info else out
     return matrix_to_local(cholesky_factorization(uplo, mat), desc)
 
 
@@ -366,14 +385,23 @@ def pposv_local(
     local_a: Dict[Tuple[int, int], np.ndarray], desc_a: Descriptor,
     local_b: Dict[Tuple[int, int], np.ndarray], desc_b: Descriptor,
     grid: Grid,
-) -> Tuple[Dict[Tuple[int, int], np.ndarray], Dict[Tuple[int, int], np.ndarray]]:
+    return_info: bool = False, raise_on_failure: bool = False,
+):
     """Factor + solve in distributed-buffer mode.  Returns (factor slabs,
-    solution slabs) for this process's grid ranks."""
+    solution slabs) for this process's grid ranks, plus the ScaLAPACK-style
+    ``info`` int when ``return_info=True``."""
     from dlaf_tpu.algorithms.solver import positive_definite_solver
 
     _check_same_source(desc_a, desc_b)
     mat_a = matrix_from_local(local_a, desc_a, grid)
-    x = positive_definite_solver(uplo, mat_a, matrix_from_local(local_b, desc_b, grid))
+    mat_b = matrix_from_local(local_b, desc_b, grid)
+    if return_info or raise_on_failure:
+        x, info = positive_definite_solver(
+            uplo, mat_a, mat_b, return_info=True, raise_on_failure=raise_on_failure
+        )
+        out = matrix_to_local(mat_a, desc_a), matrix_to_local(x, desc_b)
+        return (*out, int(info)) if return_info else out
+    x = positive_definite_solver(uplo, mat_a, mat_b)
     return matrix_to_local(mat_a, desc_a), matrix_to_local(x, desc_b)
 
 
@@ -401,10 +429,26 @@ psygvd_local = phegvd_local  # real-symmetric alias
 psyevd_local = pheevd_local  # real-symmetric alias (defined above)
 
 
-def ppotrf(ctx: int, uplo: str, a: np.ndarray, desc: Descriptor) -> np.ndarray:
-    """Cholesky factorization (dlaf_pspotrf/pdpotrf/pcpotrf/pzpotrf)."""
+def ppotrf(
+    ctx: int, uplo: str, a: np.ndarray, desc: Descriptor,
+    return_info: bool = False, raise_on_failure: bool = False,
+):
+    """Cholesky factorization (dlaf_pspotrf/pdpotrf/pcpotrf/pzpotrf).
+
+    ``return_info=True`` returns ``(factor, info)`` with ScaLAPACK's
+    p?potrf ``info`` convention: 0 = success, k > 0 = the leading minor of
+    order k is not positive definite (1-based first failing pivot);
+    ``raise_on_failure=True`` raises
+    :class:`~dlaf_tpu.health.NotPositiveDefiniteError` instead."""
     from dlaf_tpu.algorithms.cholesky import cholesky_factorization
 
+    if return_info or raise_on_failure:
+        fac, info = cholesky_factorization(
+            uplo, _dist(ctx, a, desc), return_info=True,
+            raise_on_failure=raise_on_failure,
+        )
+        g = fac.to_global()
+        return (g, int(info)) if return_info else g
     return cholesky_factorization(uplo, _dist(ctx, a, desc)).to_global()
 
 
@@ -450,12 +494,22 @@ def ppotrs(
 def pposv(
     ctx: int, uplo: str, a: np.ndarray, desc_a: Descriptor,
     b: np.ndarray, desc_b: Descriptor,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Factor + solve A X = B (p?posv).  Returns (factored A, X)."""
+    return_info: bool = False, raise_on_failure: bool = False,
+):
+    """Factor + solve A X = B (p?posv).  Returns (factored A, X), plus the
+    ScaLAPACK-style ``info`` int when ``return_info=True`` (0 = success,
+    k > 0 = leading minor of order k not positive definite)."""
     from dlaf_tpu.algorithms.solver import positive_definite_solver
 
     _check_same_source(desc_a, desc_b)
     mat_a = _dist(ctx, a, desc_a)
+    if return_info or raise_on_failure:
+        x, info = positive_definite_solver(
+            uplo, mat_a, _dist(ctx, b, desc_b), return_info=True,
+            raise_on_failure=raise_on_failure,
+        )
+        out = mat_a.to_global(), x.to_global()
+        return (*out, int(info)) if return_info else out
     x = positive_definite_solver(uplo, mat_a, _dist(ctx, b, desc_b))
     return mat_a.to_global(), x.to_global()
 

@@ -4,7 +4,11 @@ Analogue of the reference test harness helpers
 (reference: test/include/dlaf_test/matrix/util_matrix.h — set/CHECK_MATRIX_NEAR,
 test/include/dlaf_test/util_types.h — element types): matrix generators with
 known structure plus elementwise comparison with an N-scaled error budget
-(test_cholesky.cpp:76-78 scales tolerances with matrix size)."""
+(test_cholesky.cpp:76-78 scales tolerances with matrix size).
+
+The :mod:`dlaf_tpu.testing.faults` submodule injects controlled numerical
+faults (chosen failing pivots, NaN tiles, near-singular operands) to prove
+the health detectors fire — import it explicitly, it is test-only."""
 from __future__ import annotations
 
 import numpy as np

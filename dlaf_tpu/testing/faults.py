@@ -1,0 +1,68 @@
+"""Fault injection for the health detectors.
+
+LAPACK's error paths are exercised with constructed inputs (xPOTRF's
+testing drivers hand it indefinite matrices and check ``info``); this
+module is that constructor kit for dlaf_tpu: every helper builds an input
+whose failure mode — and failure LOCATION — is known exactly, so tests can
+assert the detectors report the right thing, not merely that they fire.
+
+All helpers are host-side numpy: faults are injected into the operand
+BEFORE it enters a driver, never by patching driver internals, so the
+detection path under test is exactly the production path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from dlaf_tpu.testing import random_hermitian_pd, random_matrix
+
+
+def break_spd(a: np.ndarray, pivot: int, magnitude: float = 10.0) -> np.ndarray:
+    """Return a copy of the Hermitian positive-definite ``a`` whose FIRST
+    failing Cholesky pivot is exactly ``pivot`` (0-based).
+
+    Cholesky pivot k depends only on the leading (k+1) x (k+1) minor, so
+    driving ``a[pivot, pivot]`` strongly negative fails that pivot while
+    leaving every earlier one intact: LAPACK potrf on the result returns
+    ``info == pivot + 1``, and so must ours."""
+    n = a.shape[0]
+    if not 0 <= pivot < n:
+        raise ValueError(f"pivot {pivot} outside [0, {n})")
+    out = np.array(a, copy=True)
+    scale = max(float(np.max(np.abs(a))), 1.0)
+    out[pivot, pivot] = -magnitude * scale
+    return out
+
+
+def near_spd(n: int, dtype, deficit: float = 1e-12, seed: int = 0) -> np.ndarray:
+    """Hermitian matrix that is positive definite except for one eigenvalue
+    pushed to ``-deficit`` — indefinite, but recoverable by a tiny diagonal
+    shift (the bounded-recovery target case)."""
+    a = random_hermitian_pd(n, dtype, seed=seed)
+    w, v = np.linalg.eigh(a)
+    w[0] = -abs(deficit)
+    return (v * w) @ v.conj().T
+
+
+def nan_tile(
+    a: np.ndarray, i: int, j: int, block: int, value: float = np.nan
+) -> np.ndarray:
+    """Return a copy of ``a`` with tile (i, j) of a ``block`` x ``block``
+    tiling poisoned with ``value`` (NaN by default; pass ``np.inf`` for
+    overflow-style faults).  Exercises the NaN/Inf sentinels and the
+    nonfinite-pivot branch of the info scan."""
+    out = np.array(a, copy=True)
+    rs, cs = i * block, j * block
+    if rs >= a.shape[0] or cs >= a.shape[1]:
+        raise ValueError(f"tile ({i}, {j}) outside {a.shape} at block {block}")
+    out[rs : rs + block, cs : cs + block] = value
+    return out
+
+
+def ill_conditioned_pd(n: int, dtype, cond: float = 1e12, seed: int = 0) -> np.ndarray:
+    """Hermitian positive-definite matrix with condition number ``cond``
+    (geometric eigenvalue spacing).  Past ~1/eps(low) the mixed-precision
+    refinement loop stalls and must take its fallback path."""
+    q, _ = np.linalg.qr(random_matrix(n, n, dtype, seed=seed))
+    w = np.geomspace(1.0, 1.0 / cond, n)
+    return ((q * w) @ q.conj().T).astype(np.dtype(dtype))

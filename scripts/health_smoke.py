@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Drive every health detector once and write the events to a metrics file.
+
+Usage: python scripts/health_smoke.py out.jsonl
+
+CI runs this as the health lane's artifact step: each fault class from
+dlaf_tpu.testing.faults goes through the PRODUCTION detection path (info
+codes, sentinels, recovery, fallback) and the resulting ``health`` records
+land in ``out.jsonl`` for ``scripts/report_metrics.py``.  Exit is nonzero
+if any detector fails to fire or misreports the fault location.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["DLAF_TPU_CHECK_LEVEL"] = "2"  # sentinels on
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+import dlaf_tpu
+from dlaf_tpu import health
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.solver import positive_definite_solver_mixed
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.testing import faults, random_hermitian_pd, random_matrix
+
+N, MB = 32, 8
+
+
+def dm(grid, a):
+    return DistributedMatrix.from_global(grid, np.asarray(a, np.float64), (MB, MB))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = argv[0] if argv else "health.jsonl"
+    om.enable(path)
+    om.emit_run_meta("health_smoke")
+    grid = Grid.create((1, 1))
+    failures = []
+
+    def expect(cond, what):
+        print(("ok  " if cond else "FAIL") + f"  {what}")
+        if not cond:
+            failures.append(what)
+
+    base = random_hermitian_pd(N, np.float64, seed=0)
+
+    # 1. info code: first failing pivot at a chosen location
+    pivot = 11
+    _, info = cholesky_factorization(
+        "L", dm(grid, faults.break_spd(base, pivot)), return_info=True
+    )
+    health.record("smoke_info_code", info=int(info), expected=pivot + 1)
+    expect(int(info) == pivot + 1, f"potrf info == {pivot + 1}")
+
+    # 2. taxonomy: raise_on_failure surfaces NotPositiveDefiniteError
+    try:
+        cholesky_factorization(
+            "L", dm(grid, faults.break_spd(base, 3)), raise_on_failure=True
+        )
+        expect(False, "NotPositiveDefiniteError raised")
+    except dlaf_tpu.NotPositiveDefiniteError as e:
+        health.record("smoke_taxonomy", info=e.info)
+        expect(e.info == 4, "NotPositiveDefiniteError.info == 4")
+
+    # 3. bounded recovery: near-SPD input recovers under a diagonal shift
+    out, info = cholesky_factorization(
+        "L", dm(grid, faults.near_spd(N, np.float64, deficit=1e-13)),
+        return_info=True, shift_recovery=True,
+    )
+    expect(int(info) == 0, "shift recovery factored a near-SPD input")
+
+    # 4. NaN sentinel (level 2 is exported above)
+    try:
+        health.check_finite("smoke", dm(grid, faults.nan_tile(base, 1, 1, MB)))
+        expect(False, "NonFiniteError raised")
+    except dlaf_tpu.NonFiniteError as e:
+        expect(e.stage == "smoke", "sentinel caught the poisoned tile")
+
+    # 5. mixed-precision fallback on an ill-conditioned system
+    a = faults.ill_conditioned_pd(N, np.float64, cond=1e13)
+    b = random_matrix(N, 4, np.float64, seed=1)
+    _, minfo = positive_definite_solver_mixed("L", dm(grid, a), dm(grid, b))
+    health.record(
+        "smoke_mixed", fallback=minfo.fallback, iters=minfo.iters,
+        converged=minfo.converged,
+    )
+    expect(minfo.converged, "mixed solve converged (fallback allowed)")
+
+    om.close()
+    print(f"health events written to {path}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

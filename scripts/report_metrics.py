@@ -134,6 +134,22 @@ def summarize(path: str) -> int:
             print(f"   heev: {h.get('metric', '?')} {h.get('seconds', '?')}s "
                   f"{h.get('gflops', '?')} GFlop/s")
 
+    health = by_kind.get("health", [])
+    if health:
+        counts = defaultdict(int)
+        for r in health:
+            counts[r["event"]] += 1
+        print(f"-- health events ({len(health)}):")
+        for e, n in sorted(counts.items()):
+            print(f"   {n:6d}  {e}")
+        for r in health:
+            detail = "  ".join(
+                f"{k}={r[k]}"
+                for k in sorted(r)
+                if k not in ("schema", "kind", "ts", "rank", "event")
+            )
+            print(f"   rank {r['rank']}  {r['event']}" + (f"  {detail}" if detail else ""))
+
     for r in by_kind.get("note", []):
         print(f"-- note (rank {r['rank']}): {r['text']}")
     return 0

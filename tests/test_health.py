@@ -1,0 +1,369 @@
+"""Health subsystem tests: in-graph info codes proven by fault injection,
+the error taxonomy, check-level gating of the NaN sentinels, bounded
+recovery, and the health event stream.
+
+Every fault enters through dlaf_tpu.testing.faults as a constructed INPUT
+— detection runs the production path, nothing is patched (the xPOTRF
+testing-driver methodology)."""
+import numpy as np
+import pytest
+
+import dlaf_tpu
+import dlaf_tpu.testing as tu
+from dlaf_tpu import health
+from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+from dlaf_tpu.algorithms.solver import (
+    positive_definite_solver,
+    positive_definite_solver_mixed,
+)
+from dlaf_tpu.common import checks
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.testing import faults
+
+N, MB = 24, 4
+
+
+def _mat(grid, a):
+    return DistributedMatrix.from_global(grid, a, (MB, MB))
+
+
+# ------------------------------------------------------------- info codes
+
+
+@pytest.mark.parametrize("pivot", [0, 5, 10, 17, 23])
+def test_info_names_first_failing_pivot(grid_2x4, pivot):
+    """Chosen pivot p fails -> LAPACK-style info == p + 1 (Cholesky pivot k
+    depends only on the leading minor, so break_spd pins the location)."""
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=7), pivot)
+    _, info = cholesky_factorization("L", _mat(grid_2x4, a), return_info=True)
+    assert int(info) == pivot + 1
+
+
+def test_info_zero_on_success_and_factor_unharmed(grid_2x4):
+    a = tu.random_hermitian_pd(N, np.float64, seed=3)
+    out, info = cholesky_factorization("L", _mat(grid_2x4, a), return_info=True)
+    assert int(info) == 0
+    tu.assert_near(out, np.linalg.cholesky(a), tu.tol_for(np.float64, N, 40.0), uplo="L")
+
+
+def test_info_all_grids_and_lookahead_variant(comm_grids):
+    """Info carry agrees across every grid fixture and both kernel variants
+    (the 1x1 grid must route to the distributed kernel when info is asked)."""
+    from dlaf_tpu.tune import initialize
+
+    pivot = 10
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=5), pivot)
+    for grid in comm_grids:
+        _, info = cholesky_factorization("L", _mat(grid, a), return_info=True)
+        assert int(info) == pivot + 1, grid.grid_size
+    initialize(cholesky_lookahead=True)
+    try:
+        _, info = cholesky_factorization("L", _mat(comm_grids[0], a), return_info=True)
+        assert int(info) == pivot + 1
+    finally:
+        initialize()
+
+
+def test_info_complex_and_upper(grid_2x4):
+    pivot = 9
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.complex128, seed=11), pivot)
+    _, info = cholesky_factorization("L", _mat(grid_2x4, a), return_info=True)
+    assert int(info) == pivot + 1
+    # mirroring to U storage preserves the leading minors -> same info
+    _, info_u = cholesky_factorization(
+        "U", _mat(grid_2x4, a.conj().T), return_info=True
+    )
+    assert int(info_u) == pivot + 1
+
+
+def test_info_nan_pivot_counts_as_failure(grid_2x4):
+    """A NaN-poisoned diagonal tile fails at its FIRST pivot (NaN > 0 is
+    False), not downstream where the NaNs spread to."""
+    a = faults.nan_tile(tu.random_hermitian_pd(N, np.float64, seed=2), 2, 2, MB)
+    _, info = cholesky_factorization("L", _mat(grid_2x4, a), return_info=True)
+    assert int(info) == 2 * MB + 1
+
+
+def test_posv_threads_info(grid_2x4):
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=4), 6)
+    b = tu.random_matrix(N, 3, np.float64, seed=5)
+    _, info = positive_definite_solver(
+        "L", _mat(grid_2x4, a), _mat(grid_2x4, b), return_info=True
+    )
+    assert int(info) == 7
+    with pytest.raises(dlaf_tpu.NotPositiveDefiniteError):
+        positive_definite_solver(
+            "L", _mat(grid_2x4, a), _mat(grid_2x4, b), raise_on_failure=True
+        )
+
+
+# --------------------------------------------------------------- taxonomy
+
+
+def test_raise_on_failure_carries_info(grid_2x4):
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=1), 13)
+    with pytest.raises(dlaf_tpu.NotPositiveDefiniteError) as ei:
+        cholesky_factorization("L", _mat(grid_2x4, a), raise_on_failure=True)
+    assert ei.value.info == 14
+    assert isinstance(ei.value, ArithmeticError)
+    assert isinstance(ei.value, dlaf_tpu.DlafError)
+
+
+def test_distribution_error_is_value_error(grid_2x4):
+    bad = DistributedMatrix.zeros(grid_2x4, (8, 6), (4, 4))
+    with pytest.raises(dlaf_tpu.DistributionError):
+        cholesky_factorization("L", bad)
+    with pytest.raises(ValueError):  # pre-taxonomy callers keep working
+        cholesky_factorization("L", bad)
+
+
+def test_taxonomy_hierarchy():
+    assert issubclass(dlaf_tpu.NotPositiveDefiniteError, dlaf_tpu.DlafError)
+    assert issubclass(dlaf_tpu.ConvergenceError, RuntimeError)
+    assert issubclass(dlaf_tpu.DistributionError, ValueError)
+    assert issubclass(dlaf_tpu.NonFiniteError, ArithmeticError)
+
+
+# ------------------------------------------------------- bounded recovery
+
+
+def test_shift_recovery_recovers_near_spd(grid_2x4):
+    a = faults.near_spd(N, np.float64, deficit=1e-13, seed=6)
+    with health.capture_events() as events:
+        out, info = cholesky_factorization(
+            "L", _mat(grid_2x4, a), return_info=True, shift_recovery=True
+        )
+    assert int(info) == 0
+    kinds = [e["event"] for e in events]
+    assert "cholesky_shift_retry" in kinds
+    assert kinds[-1] == "cholesky_shift_recovered"
+    shift = events[-1]["shift"]
+    # the factor reproduces the SHIFTED matrix (that is the contract)
+    L = np.tril(np.asarray(out.to_global()))
+    target = a + shift * np.eye(N)
+    err = np.max(np.abs(L @ L.conj().T - target)) / max(np.abs(target).max(), 1.0)
+    assert err < 1e-8
+
+
+def test_shift_recovery_exhaustion_reports_shift(grid_2x4):
+    """A deficit far beyond n*eps*100^k escalation stays non-SPD: info > 0
+    survives, and the raise carries the last shift tried."""
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=8), 5)
+    with health.capture_events() as events:
+        _, info = cholesky_factorization(
+            "L", _mat(grid_2x4, a), return_info=True, shift_recovery=True,
+            max_shift_attempts=2,
+        )
+    assert int(info) > 0
+    assert sum(e["event"] == "cholesky_shift_retry" for e in events) == 2
+    with pytest.raises(dlaf_tpu.NotPositiveDefiniteError) as ei:
+        cholesky_factorization(
+            "L", _mat(grid_2x4, a), raise_on_failure=True, shift_recovery=True,
+            max_shift_attempts=1,
+        )
+    assert ei.value.shift > 0
+
+
+def test_shift_recovery_preserves_original_buffer(grid_2x4):
+    """The kernels donate their input; recovery must retry from a copy."""
+    a = faults.near_spd(N, np.float64, deficit=1e-13, seed=9)
+    mat = _mat(grid_2x4, a)
+    _, info = cholesky_factorization(
+        "L", mat, return_info=True, shift_recovery=True
+    )
+    assert int(info) == 0
+
+
+# ------------------------------------------------- sentinels / check level
+
+
+def test_check_level_rereads_env(monkeypatch):
+    try:
+        monkeypatch.setenv("DLAF_TPU_CHECK_LEVEL", "0")
+        assert checks.check_level() == 0
+        monkeypatch.setenv("DLAF_TPU_CHECK_LEVEL", "2")
+        assert checks.check_level() == 2  # live re-read, not frozen at import
+        monkeypatch.setenv("DLAF_TPU_CHECK_LEVEL", "bogus")
+        assert checks.check_level() == 1
+        checks.set_check_level(0)
+        assert checks.check_level() == 0  # explicit override wins over env
+    finally:
+        checks.set_check_level(None)
+
+
+def test_check_finite_free_below_level_2(monkeypatch):
+    """Below level 2 the sentinel must not touch its operands at all —
+    byte-identical driver behavior with sentinels off."""
+    monkeypatch.setenv("DLAF_TPU_CHECK_LEVEL", "1")
+
+    class Tripwire:
+        @property
+        def data(self):  # pragma: no cover - reaching this IS the failure
+            raise AssertionError("sentinel touched an operand below level 2")
+
+    health.check_finite("stage", Tripwire())
+    health.check_finite("stage", np.array([np.nan]))  # not even inspected
+
+
+def test_check_finite_raises_at_level_2(grid_2x4):
+    a = faults.nan_tile(tu.random_hermitian_pd(N, np.float64, seed=1), 1, 0, MB)
+    checks.set_check_level(2)
+    try:
+        with health.capture_events() as events:
+            with pytest.raises(dlaf_tpu.NonFiniteError) as ei:
+                health.check_finite("unit", _mat(grid_2x4, a))
+        assert ei.value.stage == "unit"
+        assert events == [{"event": "nonfinite", "stage": "unit"}]
+        health.check_finite("unit", _mat(grid_2x4, np.nan_to_num(a)), None)  # clean + None ok
+    finally:
+        checks.set_check_level(None)
+
+
+def test_eigensolver_sentinel_names_first_stage(grid_2x4):
+    from dlaf_tpu.algorithms.eigensolver import hermitian_eigensolver
+
+    a = faults.nan_tile(tu.random_hermitian_pd(16, np.float64, seed=12), 0, 0, 4)
+    checks.set_check_level(2)
+    try:
+        with pytest.raises(dlaf_tpu.NonFiniteError) as ei:
+            hermitian_eigensolver(
+                "L", DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+            )
+        assert ei.value.stage == "red2band"  # first seam after the poison
+    finally:
+        checks.set_check_level(None)
+
+
+# ------------------------------------------------------------ convergence
+
+
+def test_mixed_solver_stall_raises(grid_2x4):
+    a = faults.ill_conditioned_pd(N, np.float64, cond=1e14, seed=3)
+    b = tu.random_matrix(N, 2, np.float64, seed=4)
+    with health.capture_events() as events:
+        with pytest.raises(dlaf_tpu.ConvergenceError) as ei:
+            positive_definite_solver_mixed(
+                "L", _mat(grid_2x4, a), _mat(grid_2x4, b),
+                fallback=False, raise_on_failure=True,
+            )
+    assert ei.value.info is not None and not ei.value.info.converged
+    assert any(e["event"] == "mixed_solve_stalled" for e in events)
+
+
+def test_mixed_solver_fallback_recorded(grid_2x4):
+    a = faults.ill_conditioned_pd(N, np.float64, cond=1e14, seed=3)
+    b = tu.random_matrix(N, 2, np.float64, seed=4)
+    with health.capture_events() as events:
+        x, info = positive_definite_solver_mixed(
+            "L", _mat(grid_2x4, a), _mat(grid_2x4, b)
+        )
+    assert info.fallback and info.converged
+    assert any(e["event"] == "mixed_solve_fallback" for e in events)
+
+
+def test_eig_refine_raise_on_failure(grid_2x4):
+    from dlaf_tpu.algorithms.eig_refine import hermitian_eigensolver_mixed
+
+    a = tu.random_hermitian_pd(16, np.float64, seed=13)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+    # max_iters=0 on the partial path: one RR rotation cannot push the
+    # residual from the f32 floor (~1e-7) to the f64 criterion (~1e-13)
+    with health.capture_events() as events:
+        with pytest.raises(dlaf_tpu.ConvergenceError):
+            hermitian_eigensolver_mixed(
+                "L", mat, max_iters=0, spectrum=(0, 3), raise_on_failure=True
+            )
+    assert any("not_converged" in e["event"] for e in events)
+    with pytest.raises(dlaf_tpu.DistributionError):
+        hermitian_eigensolver_mixed("L", mat, spectrum=(-1, 3))
+
+
+def test_tridiag_info_and_raise(grid_1x1):
+    from dlaf_tpu.algorithms.tridiag_dc import tridiag_dc
+    from dlaf_tpu.algorithms.tridiag_solver import tridiagonal_eigensolver
+
+    rng = np.random.default_rng(0)
+    d, e = rng.standard_normal(12), rng.standard_normal(11)
+    lam, q, info = tridiag_dc(d, e, return_info=True)
+    assert int(info) == 0
+    d_bad = d.copy()
+    d_bad[4] = np.nan
+    lam, q, info = tridiag_dc(d_bad, e, return_info=True)
+    assert int(info) > 0
+    with health.capture_events() as events:
+        with pytest.raises(dlaf_tpu.ConvergenceError) as ei:
+            tridiagonal_eigensolver(
+                grid_1x1, d_bad, e, 4, backend="dc", raise_on_failure=True
+            )
+    assert ei.value.info >= 1
+    assert any(e_["event"] == "tridiag_nonfinite" for e_ in events)
+    # clean input passes with the knob on
+    tridiagonal_eigensolver(grid_1x1, d, e, 4, backend="dc", raise_on_failure=True)
+
+
+# ---------------------------------------------------- multihost retry path
+
+
+def test_multihost_retry_backoff(monkeypatch):
+    import jax
+
+    from dlaf_tpu.comm import multihost
+
+    calls = []
+
+    def fake_init(**kw):
+        calls.append(kw)
+        raise RuntimeError("coordinator connect failed")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(multihost, "_initialized", False)
+    monkeypatch.setattr(multihost, "_world_up", False)
+    with health.capture_events() as events:
+        with pytest.raises(RuntimeError):
+            multihost.initialize("h:1", 2, 0, retries=2, backoff_s=0.001)
+    assert len(calls) == 3  # first try + 2 retries
+    assert [e["event"] for e in events] == ["multihost_retry"] * 2
+    assert [e["attempt"] for e in events] == [1, 2]
+
+    # deadline cuts retries short
+    calls.clear()
+    monkeypatch.setattr(multihost, "_initialized", False)
+    with pytest.raises(RuntimeError):
+        multihost.initialize("h:1", 2, 0, retries=5, backoff_s=0.001, deadline_s=0.0)
+    assert len(calls) == 1
+
+    # defaults: no retry at all (pre-PR behavior)
+    calls.clear()
+    monkeypatch.setattr(multihost, "_initialized", False)
+    with pytest.raises(RuntimeError):
+        multihost.initialize("h:1", 2, 0)
+    assert len(calls) == 1
+
+
+# ------------------------------------------------------------ event stream
+
+
+def test_capture_events_nesting():
+    with health.capture_events() as outer:
+        health.record("a", x=1)
+        with health.capture_events() as inner:
+            health.record("b")
+        health.record("c")
+    assert [e["event"] for e in outer] == ["a", "c"]
+    assert [e["event"] for e in inner] == ["b"]
+    health.record("dropped")  # no capture, no metrics stream: free no-op
+
+
+def test_health_events_reach_metrics(tmp_path):
+    from dlaf_tpu.obs import metrics as om
+
+    path = str(tmp_path / "h.jsonl")
+    om.enable(path)
+    try:
+        health.record("unit_event", detail=7)
+    finally:
+        om.close()
+    recs = [r for r in om.read_jsonl(path) if r["kind"] == "health"]
+    assert len(recs) == 1 and recs[0]["event"] == "unit_event" and recs[0]["detail"] == 7
+    for r in recs:
+        om.validate_record(r)  # "health" is a registered kind

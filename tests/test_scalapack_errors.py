@@ -1,0 +1,125 @@
+"""ScaLAPACK shim error paths: descriptor misuse raises DistributionError
+(a ValueError, matching the reference C API's pre-flight checks) and
+numerical failure follows the p?potrf/p?posv ``info`` convention."""
+import numpy as np
+import pytest
+
+import dlaf_tpu
+import dlaf_tpu.testing as tu
+from dlaf_tpu.scalapack import api
+from dlaf_tpu.testing import faults
+
+N, NB = 16, 4
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = api.create_grid(2, 2)
+    yield c
+    api.free_grid(c)
+
+
+def test_wrong_descriptor_shape(ctx):
+    a = tu.random_hermitian_pd(N, np.float64, seed=0)
+    desc = api.make_desc(N + 1, N, NB, NB)  # descriptor disagrees with array
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.ppotrf(ctx, "L", a, desc)
+    with pytest.raises(ValueError):  # still a ValueError for old callers
+        api.ppotrf(ctx, "L", a, desc)
+
+
+def test_unknown_context():
+    a = tu.random_hermitian_pd(N, np.float64, seed=0)
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.ppotrf(123456, "L", a, api.make_desc(N, N, NB, NB))
+
+
+def test_source_rank_outside_grid(ctx):
+    a = tu.random_hermitian_pd(N, np.float64, seed=0)
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.ppotrf(ctx, "L", a, api.make_desc(N, N, NB, NB, isrc=5, jsrc=0))
+
+
+def test_non_square_tiles(ctx):
+    a = tu.random_hermitian_pd(N, np.float64, seed=0)
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.ppotrf(ctx, "L", a, api.make_desc(N, N, NB, 2))
+
+
+def test_mismatched_source_ranks(ctx):
+    a = tu.random_hermitian_pd(N, np.float64, seed=0)
+    b = tu.random_matrix(N, 2, np.float64, seed=1)
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.pposv(
+            ctx, "L", a, api.make_desc(N, N, NB, NB, isrc=1),
+            b, api.make_desc(N, 2, NB, NB, isrc=0),
+        )
+
+
+def test_ppotrf_info_non_spd(ctx):
+    pivot = 6
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=2), pivot)
+    desc = api.make_desc(N, N, NB, NB)
+    _, info = api.ppotrf(ctx, "L", a, desc, return_info=True)
+    assert info == pivot + 1
+    with pytest.raises(dlaf_tpu.NotPositiveDefiniteError) as ei:
+        api.ppotrf(ctx, "L", a, desc, raise_on_failure=True)
+    assert ei.value.info == pivot + 1
+
+
+def test_ppotrf_info_success_matches_plain(ctx):
+    a = tu.random_hermitian_pd(N, np.float64, seed=3)
+    desc = api.make_desc(N, N, NB, NB)
+    fac, info = api.ppotrf(ctx, "L", a, desc, return_info=True)
+    assert info == 0
+    np.testing.assert_allclose(
+        np.tril(fac), np.linalg.cholesky(a), atol=tu.tol_for(np.float64, N, 40.0)
+    )
+
+
+def test_pposv_info(ctx):
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=4), 2)
+    b = tu.random_matrix(N, 3, np.float64, seed=5)
+    desc_a = api.make_desc(N, N, NB, NB)
+    desc_b = api.make_desc(N, 3, NB, NB)
+    _, _, info = api.pposv(ctx, "L", a, desc_a, b, desc_b, return_info=True)
+    assert info == 3
+    with pytest.raises(dlaf_tpu.NotPositiveDefiniteError):
+        api.pposv(ctx, "L", a, desc_a, b, desc_b, raise_on_failure=True)
+    # clean system: info 0 and the solve is right
+    a_ok = tu.random_hermitian_pd(N, np.float64, seed=6)
+    _, x, info = api.pposv(ctx, "L", a_ok, desc_a, b, desc_b, return_info=True)
+    assert info == 0
+    np.testing.assert_allclose(
+        x, np.linalg.solve(a_ok, b), atol=tu.tol_for(np.float64, N, 2000.0)
+    )
+
+
+def test_ppotrf_local_info(ctx):
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index import Size2D
+
+    grid = Grid.create(Size2D(2, 2))
+    pivot = 9
+    a = faults.break_spd(tu.random_hermitian_pd(N, np.float64, seed=7), pivot)
+    desc = api.make_desc(N, N, NB, NB)
+    local = api.global_to_local(a, desc, grid)
+    _, info = api.ppotrf_local("L", local, desc, grid, return_info=True)
+    assert info == pivot + 1
+    local_ok = api.global_to_local(
+        tu.random_hermitian_pd(N, np.float64, seed=8), desc, grid
+    )
+    _, info = api.ppotrf_local("L", local_ok, desc, grid, return_info=True)
+    assert info == 0
+
+
+def test_matrix_from_local_bad_keys_is_distribution_error(ctx):
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index import Size2D
+
+    grid = Grid.create(Size2D(2, 2))
+    desc = api.make_desc(N, N, NB, NB)
+    local = api.global_to_local(tu.random_hermitian_pd(N, np.float64, 9), desc, grid)
+    local[(7, 7)] = np.zeros((2, 2))  # not a grid position of this process
+    with pytest.raises(dlaf_tpu.DistributionError):
+        api.matrix_from_local(local, desc, grid)
