@@ -397,6 +397,40 @@ class _Child:
 
 # --------------------------- parent --------------------------------------
 
+def _last_good_bench_record():
+    """Most recent repo-root BENCH_r*.json driver artifact whose headline
+    value is nonzero, as ``(filename, record)`` — or None.  A dead-device
+    window re-emits these values marked ``stale: true`` instead of zeros,
+    so downstream consumers that track the headline number see the last
+    measured value with an explicit staleness flag rather than a
+    regression to 0."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rec = art.get("parsed")
+        if not isinstance(rec, dict):
+            # older artifacts keep the emitted JSON line only in "tail"
+            rec = None
+            for line in reversed(str(art.get("tail", "")).splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        rec = None
+                    break
+        if isinstance(rec, dict) and rec.get("value", 0.0) > 0.0:
+            best = (os.path.basename(path), rec)
+    return best
+
+
 def _probe_until_alive(t_start, attempts):
     """Retry the liveness probe in fresh subprocesses until the device
     answers or the window closes.  Returns True when alive, False when the
@@ -449,12 +483,22 @@ def main():
     t_start = time.perf_counter()
     attempts = []
     if not _probe_until_alive(t_start, attempts):
-        rec = _empty_record(
+        note = (
             f"device unresponsive for the whole window: {len(attempts)} probe "
             f"attempts over {time.perf_counter() - t_start:.0f}s, each a fresh "
             f"process/PJRT client with a {PROBE_ATTEMPT_TIMEOUT_S}s deadline"
         )
-        rec["probe_attempts"] = attempts
+        prior = _last_good_bench_record()
+        if prior is not None:
+            src, rec = prior
+            rec = dict(rec)
+            rec["stale"] = True
+            rec["stale_source"] = src
+            rec["note"] = f"STALE (device dead this window, values from {src}); {note}"
+            rec["probe_attempts"] = attempts
+        else:
+            rec = _empty_record(note)
+            rec["probe_attempts"] = attempts
         print(json.dumps(rec))
         return 124
 

@@ -129,6 +129,7 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
     key = (
         "cols", grid.cache_key, g_a, dist, tuple(cols.data.shape),
         n_panels, band, prec, np.dtype(cols.data.dtype),
+        coll.collectives_trace_key(),
     )
     if key not in _cache:
 
@@ -202,7 +203,8 @@ def bt_reduction_to_band(
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec)
+    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec,
+           coll.collectives_trace_key())
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))

@@ -120,10 +120,12 @@ def _chol_L_kernel(x, g: _spmd.Geometry, want_info: bool = False):
             xc = _spmd.take_col(x, lkc, g)
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
             below = (gi > k)[:, None, None]
-            cp_own = jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan))
+            cp_own = jnp.where(below, pan, jnp.zeros_like(pan))
         # 3. column panel to all rank columns; transposed row panel
+        # (one-contributor broadcast from rank column kc; the `below` mask
+        # zeroes non-panel rows on the root before the wire)
         with _scope("chol.panel_bcast"):
-            cp = coll.psum_axis(cp_own, COL_AXIS)  # [ltr, mb, mb]
+            cp = coll.bcast(cp_own, kc, COL_AXIS)  # [ltr, mb, mb]
             rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
         # write back the factored column (pivot tile + sub-diagonal tiles)
         new_col = jnp.where(
@@ -176,9 +178,7 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry, want_info: bool = False):
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
             below = (gi_w > k)[:, None, None]
         with _scope("chol.panel_bcast"):
-            cp = coll.psum_axis(
-                jnp.where(below & (myc == kc), pan, jnp.zeros_like(pan)), COL_AXIS
-            )
+            cp = coll.bcast(jnp.where(below, pan, jnp.zeros_like(pan)), kc, COL_AXIS)
             rp = coll.transpose_panel_windowed(cp, jv, rs, g.mt)
         # write the factored panel (window rows) and the diagonal tile
         new_col = jnp.where(below & (myc == kc), pan, xc)
@@ -231,8 +231,8 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
             below = (gi > k)[:, None, None]
         with _scope("chol.panel_bcast"):
-            cp = coll.psum_axis(
-                jnp.where(below & (myc == k % g.pc), pan, jnp.zeros_like(pan)), COL_AXIS
+            cp = coll.bcast(
+                jnp.where(below, pan, jnp.zeros_like(pan)), k % g.pc, COL_AXIS
             )
         return lkk, cp, bad
 
@@ -292,7 +292,8 @@ def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
               want_info: bool = False):
     # only the bucketed variant bakes ratio-dependent segments
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(), want_info)
+    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(),
+           coll.collectives_trace_key(), want_info)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,

@@ -91,12 +91,10 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
             corr = jnp.asarray(half, a.dtype) * jnp.einsum("iab,bc->iac", xl, akk)
             pan1 = pan - corr  # the value her2k uses
             mine_c = myc == kc
-            cp_a = coll.psum_axis(
-                jnp.where(below & mine_c, pan1, jnp.zeros_like(pan1)), COL_AXIS
+            cp_a = coll.bcast(
+                jnp.where(below, pan1, jnp.zeros_like(pan1)), kc, COL_AXIS
             )
-            cp_l = coll.psum_axis(
-                jnp.where(below & mine_c, xl, jnp.zeros_like(xl)), COL_AXIS
-            )
+            cp_l = coll.bcast(jnp.where(below, xl, jnp.zeros_like(xl)), kc, COL_AXIS)
             rp_a = coll.transpose_panel_windowed(cp_a, jv, rs, g.mt)
             rp_l = coll.transpose_panel_windowed(cp_l, jv, rs, g.mt)
         # write back the twice-corrected panel and the transformed diag tile
@@ -153,7 +151,8 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
         return mat_a_full
     if (g.mb, g.pr, g.pc, g.mt) != (g_b.mb, g_b.pr, g_b.pc, g_b.mt):
         raise ValueError("gen_to_std: A and B distributions must match")
-    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio(), _spmd.trsm_trace_key())
+    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio(), _spmd.trsm_trace_key(),
+           coll.collectives_trace_key())
     if key not in _cache:
         _cache[key] = coll.spmd(
             mat_a_full.grid,

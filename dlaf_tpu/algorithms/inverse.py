@@ -56,9 +56,7 @@ def _trtri_lower_kernel(x, g: _spmd.Geometry, diag):
         # original column k below diagonal, to every rank column
         xc = _spmd.take_col(x, lkc, g)
         below = (gi > k)[:, None, None]
-        cp = coll.psum_axis(
-            jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
-        )
+        cp = coll.bcast(jnp.where(below, xc, jnp.zeros_like(xc)), kc, COL_AXIS)
         rp = coll.transpose_panel(cp, g.mt, g.ltc)  # L[j,k] at local cols j>k
         # S[i] = sum_j inv[i,j] L[j,k] over trailing cols (inv cols > k final);
         # tiles above the diagonal are never referenced (may hold garbage)
@@ -104,9 +102,7 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
         # original column k below the diagonal, to every rank column
         with _scope("trtri.panel_bcast"):
             xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-            cp = coll.psum_axis(
-                jnp.where(below & (myc == kc), xc, jnp.zeros_like(xc)), COL_AXIS
-            )
+            cp = coll.bcast(jnp.where(below, xc, jnp.zeros_like(xc)), kc, COL_AXIS)
             rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k], j window
         # S[i] = sum_j inv[i,j] L[j,k] over the trailing slab (inv final there)
         with _scope("trtri.update"):
@@ -157,9 +153,7 @@ def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
         # windowed row panel of U[k, cs:cs+C] (covers all trailing cols > k)
         with _scope("trtri.panel_bcast"):
             xr = lax.dynamic_slice(x, (lkr, cs, 0, 0), (1, C, g.mb, g.mb))[0]
-            rp = coll.psum_axis(
-                jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
-            )
+            rp = coll.bcast(jnp.where(right, xr, jnp.zeros_like(xr)), kr, ROW_AXIS)
             # row panel U[k, v] -> windowed col panel indexed by window rows i
             cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
         with _scope("trtri.update"):
@@ -201,9 +195,7 @@ def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
         # original row k right of diagonal, to every rank row
         xr = _spmd.take_row(x, lkr, g)
         right = (gj > k)[:, None, None]
-        rp = coll.psum_axis(
-            jnp.where(right & (myr == kr), xr, jnp.zeros_like(xr)), ROW_AXIS
-        )
+        rp = coll.bcast(jnp.where(right, xr, jnp.zeros_like(xr)), kr, ROW_AXIS)
         cp = coll.transpose_panel_rows(rp, g.nt, g.ltr)  # U[k,i] at local rows i>k
         # S[j] = sum_i U[k,i] inv[i,j] over trailing rows (inv rows > k final);
         # tiles below the diagonal are never referenced (may hold garbage)
@@ -269,7 +261,8 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
 
     # bucketed kernels bake ratio-dependent trailing windows at trace time
     ratio = _spmd.bucket_ratio()
-    key = (mat_a.grid.cache_key, uplo, diag, g, ratio, _spmd.trsm_trace_key())
+    key = (mat_a.grid.cache_key, uplo, diag, g, ratio, _spmd.trsm_trace_key(),
+           coll.collectives_trace_key())
     if key not in _cache:
         kern_fn = (
             _trtri_lower_bucketed_kernel if uplo == t.LOWER else _trtri_upper_bucketed_kernel

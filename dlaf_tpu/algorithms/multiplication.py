@@ -58,9 +58,7 @@ def _a_col_panel(a, k, g_a, myr, myc, op, structure, diag, ltr_out, mt_out):
         kc = k % g_a.pc
         ac = _spmd.take_col(a, k // g_a.pc, g_a)
         ac = _structure_mask_col(ac, gi, k, structure, diag)
-        return coll.psum_axis(
-            jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS
-        )
+        return coll.bcast(ac, kc, COL_AXIS)
 
     def from_row():
         # row k of A (tiles A[k, j]), op-transposed into a column panel
@@ -71,7 +69,7 @@ def _a_col_panel(a, k, g_a, myr, myc, op, structure, diag, ltr_out, mt_out):
             jnp.swapaxes(ar, -1, -2), gj, k, _transpose_structure(structure), diag
         )
         ar = jnp.swapaxes(ar, -1, -2)
-        rp = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        rp = coll.bcast(ar, kr, ROW_AXIS)
         cp = coll.transpose_panel_rows(rp, mt_out, ltr_out)
         return t.op_tile(cp, op)
 
@@ -86,12 +84,12 @@ def _a_col_panel(a, k, g_a, myr, myc, op, structure, diag, ltr_out, mt_out):
         # make the diagonal tile exactly Hermitian from its stored triangle
         dmask = (gi == k)[:, None, None]
         ac = jnp.where(dmask, _hermitize_tile(ac, lower), ac)
-        cp1 = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+        cp1 = coll.bcast(ac, kc, COL_AXIS)
         ar = _spmd.take_row(a, k // g_a.pr, g_a)
         gj = jnp.arange(g_a.ltc) * g_a.pc + myc
         keep_row = (gj < k) if lower else (gj > k)  # strict mirror: diag from col
         ar = jnp.where(keep_row[:, None, None], ar, jnp.zeros_like(ar))
-        rp = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        rp = coll.bcast(ar, kr, ROW_AXIS)
         cp2 = t.op_tile(coll.transpose_panel_rows(rp, mt_out, ltr_out), t.CONJ_TRANS)
         return cp1 + cp2
     if op == t.NO_TRANS:
@@ -133,10 +131,10 @@ def _b_row_panel(b, k, g_b, myr, myc, op, ltc_out, nt_out):
     if op == t.NO_TRANS:
         kr = k % g_b.pr
         br = _spmd.take_row(b, k // g_b.pr, g_b)
-        return coll.psum_axis(jnp.where(myr == kr, br, jnp.zeros_like(br)), ROW_AXIS)
+        return coll.bcast(br, kr, ROW_AXIS)
     kc = k % g_b.pc
     bc = _spmd.take_col(b, k // g_b.pc, g_b)
-    cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
+    cp = coll.bcast(bc, kc, COL_AXIS)
     rp = coll.transpose_panel(cp, nt_out, ltc_out)
     return t.op_tile(rp, op)
 
@@ -222,7 +220,7 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
         return _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, False)
     key = (
         mat_c.grid.cache_key, opa, opb, complex(alpha), complex(beta), structure,
-        diag, kt, g_a, g_b, g_c,
+        diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
     )
     if key not in _cache:
         kern = partial(
@@ -295,7 +293,7 @@ def _summa_right_kernel(a, b, c, g_a, g_b, g_c, opa, alpha, beta, structure, dia
             # col panel: B[:, k] broadcast along 'c'
             kc = k % g_b.pc
             bc = _spmd.take_col(b, k // g_b.pc, g_b)
-            cp = coll.psum_axis(jnp.where(myc == kc, bc, jnp.zeros_like(bc)), COL_AXIS)
+            cp = coll.bcast(bc, kc, COL_AXIS)
             # row panel: op(A)[k, :] — use the col-panel machinery on the
             # transposed problem: op(A)[k, j] = opT(op(A)^T[j, k])
             rp = _a_row_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltc, g_c.nt)
@@ -318,12 +316,12 @@ def _a_row_panel(a, k, g_a, myr, myc, op, structure, diag, ltc_out, nt_out):
         ar = jnp.where(keep_row[:, None, None], ar, jnp.zeros_like(ar))
         dmask = (gj == k)[:, None, None]
         ar = jnp.where(dmask, _hermitize_tile(ar, lower), ar)
-        rp1 = coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        rp1 = coll.bcast(ar, kr, ROW_AXIS)
         ac = _spmd.take_col(a, k // g_a.pc, g_a)
         gi = jnp.arange(g_a.ltr) * g_a.pr + myr
         keep_col = (gi > k) if lower else (gi < k)
         ac = jnp.where(keep_col[:, None, None], ac, jnp.zeros_like(ac))
-        cp = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+        cp = coll.bcast(ac, kc, COL_AXIS)
         rp2 = t.op_tile(coll.transpose_panel(cp, nt_out, ltc_out), t.CONJ_TRANS)
         return rp1 + rp2
     if op == t.NO_TRANS:
@@ -336,13 +334,13 @@ def _a_row_panel(a, k, g_a, myr, myc, op, structure, diag, ltc_out, nt_out):
             -1,
             -2,
         )
-        return coll.psum_axis(jnp.where(myr == kr, ar, jnp.zeros_like(ar)), ROW_AXIS)
+        return coll.bcast(ar, kr, ROW_AXIS)
     # transposed: op(A)[k, j] = op(A[j, k]): fetch A column k, redistribute
     kc = k % g_a.pc
     ac = _spmd.take_col(a, k // g_a.pc, g_a)
     gi = jnp.arange(g_a.ltr) * g_a.pr + myr
     ac = _structure_mask_col(ac, gi, k, structure, diag)
-    cp = coll.psum_axis(jnp.where(myc == kc, ac, jnp.zeros_like(ac)), COL_AXIS)
+    cp = coll.bcast(ac, kc, COL_AXIS)
     return t.op_tile(coll.transpose_panel(cp, nt_out, ltc_out), op)
 
 
@@ -359,7 +357,7 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
     kt = g_b.nt
     key = (
         "right", mat_c.grid.cache_key, opa, complex(alpha), complex(beta),
-        structure, diag, kt, g_a, g_b, g_c,
+        structure, diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
     )
     if key not in _cache:
         kern = partial(
@@ -408,9 +406,7 @@ def _sub_gemm_kernel(
         # --- A panel: tiles A[ai0 + rel_i, ak0 + k], broadcast along 'c'
         gka = ak0 + k
         ac = _spmd.take_col(a, gka // pc, g_a)  # [ltr_a, mb, nb]
-        ac = coll.psum_axis(
-            jnp.where(myc == gka % pc, ac, jnp.zeros_like(ac)), COL_AXIS
-        )
+        ac = coll.bcast(ac, gka % pc, COL_AXIS)
         if aligned_r:
             la = jnp.clip((ai0 + rel_i) // pr, 0, g_a.ltr - 1)
             ap = jnp.take(ac, la, axis=0)
@@ -434,9 +430,7 @@ def _sub_gemm_kernel(
         # --- B panel: tiles B[bk0 + k, bj0 + rel_j], broadcast along 'r'
         gkb = bk0 + k
         br = _spmd.take_row(b, gkb // pr, g_b)  # [ltc_b, mb, nb]
-        br = coll.psum_axis(
-            jnp.where(myr == gkb % pr, br, jnp.zeros_like(br)), ROW_AXIS
-        )
+        br = coll.bcast(br, gkb % pr, ROW_AXIS)
         if aligned_c:
             lb = jnp.clip((bj0 + rel_j) // pc, 0, g_b.ltc - 1)
             bp = jnp.take(br, lb, axis=0)
@@ -533,6 +527,7 @@ def general_sub_multiplication(
     key = (
         "subgemm", mat_c.grid.cache_key, complex(alpha), complex(beta),
         origins, Ri, Rj, Rk, g_a, g_b, g_c, aliased,
+        coll.collectives_trace_key(),
     )
     if key not in _cache:
         kern = partial(

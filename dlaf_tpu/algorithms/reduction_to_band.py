@@ -284,7 +284,8 @@ def reduction_to_band(
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (mat_a.grid.cache_key, g, band, prec, _spmd.bucket_ratio())
+    key = (mat_a.grid.cache_key, g, band, prec, _spmd.bucket_ratio(),
+           coll.collectives_trace_key())
     if key not in _cache:
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))

@@ -51,25 +51,23 @@ def _trsm_left_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op, 
             # solve tile-row k of B (batched over this rank's local cols)
             brow = _spmd.take_row(b, lkr, g_b)
             solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-            xr = coll.psum_axis(
-                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-            )
+            xr = coll.bcast(solved, kr, ROW_AXIS)
         b = _spmd.put_row(b, jnp.where(myr == kr, solved, brow), lkr)
         # panel of op(A)[i, k] for remaining rows i
         remaining = (gi > k) if forward else (gi < k)
         if op == t.NO_TRANS:
             ac = _spmd.take_col(a, k // g_a.pc, g_a)
-            cp = coll.psum_axis(
-                jnp.where((myc == kc) & remaining[:, None, None], ac, jnp.zeros_like(ac)),
-                COL_AXIS,
+            cp = coll.bcast(
+                jnp.where(remaining[:, None, None], ac, jnp.zeros_like(ac)),
+                kc, COL_AXIS,
             )
         else:
             ar = _spmd.take_row(a, lkr, g_a)  # tiles A[k, j] for local cols j
             gj = _spmd.local_col_tiles(g_a, myc)
             rem_j = (gj > k) if forward else (gj < k)
-            rp = coll.psum_axis(
-                jnp.where((myr == kr) & rem_j[:, None, None], ar, jnp.zeros_like(ar)),
-                ROW_AXIS,
+            rp = coll.bcast(
+                jnp.where(rem_j[:, None, None], ar, jnp.zeros_like(ar)),
+                kr, ROW_AXIS,
             )
             cp = t.op_tile(coll.transpose_panel_rows(rp, g_a.mt, g_b.ltr), op)
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
@@ -102,25 +100,23 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
             # solve tile-col k of B (batched over this rank's local rows)
             bcol = _spmd.take_col(b, lkc, g_b)
             solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
-            xc = coll.psum_axis(
-                jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
-            )
+            xc = coll.bcast(solved, kc, COL_AXIS)
         b = _spmd.put_col(b, jnp.where(myc == kc, solved, bcol), lkc)
         # panel of op(A)[k, j] for remaining cols j
         remaining = (gj > k) if forward else (gj < k)
         if op == t.NO_TRANS:
             ar = _spmd.take_row(a, k // g_a.pr, g_a)
-            rp = coll.psum_axis(
-                jnp.where((myr == kr) & remaining[:, None, None], ar, jnp.zeros_like(ar)),
-                ROW_AXIS,
+            rp = coll.bcast(
+                jnp.where(remaining[:, None, None], ar, jnp.zeros_like(ar)),
+                kr, ROW_AXIS,
             )
         else:
             ac = _spmd.take_col(a, lkc, g_a)  # tiles A[i, k] for local rows i
             gi = _spmd.local_row_tiles(g_a, myr)
             rem_i = (gi > k) if forward else (gi < k)
-            cp = coll.psum_axis(
-                jnp.where((myc == kc) & rem_i[:, None, None], ac, jnp.zeros_like(ac)),
-                COL_AXIS,
+            cp = coll.bcast(
+                jnp.where(rem_i[:, None, None], ac, jnp.zeros_like(ac)),
+                kc, COL_AXIS,
             )
             rp = t.op_tile(coll.transpose_panel(cp, g_a.nt, g_b.ltc), op)
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
@@ -154,9 +150,7 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
             brow = _spmd.take_row(b, lkr, g_b)
             solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-            xr = coll.psum_axis(
-                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-            )
+            xr = coll.bcast(solved, kr, ROW_AXIS)
         b = _spmd.put_row(b, jnp.where(myr == kr, solved, brow), lkr)
         # remaining-rows window
         if forward:
@@ -170,17 +164,17 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             ac = lax.dynamic_slice(
                 a, (rs, k // g_a.pc, 0, 0), (L, 1, g_a.mb, g_a.mb)
             )[:, 0]
-            cp = coll.psum_axis(
-                jnp.where((myc == kc) & remaining[:, None, None], ac, jnp.zeros_like(ac)),
-                COL_AXIS,
+            cp = coll.bcast(
+                jnp.where(remaining[:, None, None], ac, jnp.zeros_like(ac)),
+                kc, COL_AXIS,
             )
         else:
             ar = _spmd.take_row(a, lkr, g_a)
             gj = _spmd.local_col_tiles(g_a, myc)
             rem_j = (gj > k) if forward else (gj < k)
-            rp = coll.psum_axis(
-                jnp.where((myr == kr) & rem_j[:, None, None], ar, jnp.zeros_like(ar)),
-                ROW_AXIS,
+            rp = coll.bcast(
+                jnp.where(rem_j[:, None, None], ar, jnp.zeros_like(ar)),
+                kr, ROW_AXIS,
             )
             # row panel -> windowed col panel: tiles indexed by A's col j
             cp = t.op_tile(coll.transpose_panel_rows_windowed(rp, gi_w, 0, g_a.mt), op)
@@ -219,9 +213,7 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
             bcol = _spmd.take_col(b, lkc, g_b)
             solved = t.trsm(t.RIGHT, uplo, op, diag, 1.0, akk, bcol)
-            xc = coll.psum_axis(
-                jnp.where(myc == kc, solved, jnp.zeros_like(solved)), COL_AXIS
-            )
+            xc = coll.bcast(solved, kc, COL_AXIS)
         b = _spmd.put_col(b, jnp.where(myc == kc, solved, bcol), lkc)
         # remaining-cols window
         if forward:
@@ -235,17 +227,17 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             ar = lax.dynamic_slice(
                 a, (k // g_a.pr, cs, 0, 0), (1, C, g_a.mb, g_a.mb)
             )[0]
-            rp = coll.psum_axis(
-                jnp.where((myr == kr) & remaining[:, None, None], ar, jnp.zeros_like(ar)),
-                ROW_AXIS,
+            rp = coll.bcast(
+                jnp.where(remaining[:, None, None], ar, jnp.zeros_like(ar)),
+                kr, ROW_AXIS,
             )
         else:
             ac = _spmd.take_col(a, lkc, g_a)  # tiles A[i, k] for local rows i
             gi = _spmd.local_row_tiles(g_a, myr)
             rem_i = (gi > k) if forward else (gi < k)
-            cp = coll.psum_axis(
-                jnp.where((myc == kc) & rem_i[:, None, None], ac, jnp.zeros_like(ac)),
-                COL_AXIS,
+            cp = coll.bcast(
+                jnp.where(rem_i[:, None, None], ac, jnp.zeros_like(ac)),
+                kc, COL_AXIS,
             )
             # col panel -> windowed row panel: tiles indexed by A's row j
             rp = t.op_tile(coll.transpose_panel_windowed(cp, gj_w, 0, g_a.nt), op)
@@ -299,10 +291,7 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             akk = _spmd.bcast_diag_tile(a, k, g_a, myr, myc)
             brow = _spmd.take_row(b, k // g_a.pr, g_b)
             solved = t.trsm(t.LEFT, uplo, op, diag, 1.0, akk, brow)
-            xr = coll.psum_axis(
-                jnp.where(myr == kr, solved, jnp.zeros_like(solved)), ROW_AXIS
-            )
-            return xr
+            return coll.bcast(solved, kr, ROW_AXIS)
 
     def write_row(b, k, xr):
         lkr = k // g_a.pr
@@ -315,17 +304,17 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         if op == t.NO_TRANS:
             kc = k % g_a.pc
             ac = _spmd.take_col(a, k // g_a.pc, g_a)
-            return coll.psum_axis(
-                jnp.where((myc == kc) & remaining[:, None, None], ac, jnp.zeros_like(ac)),
-                COL_AXIS,
+            return coll.bcast(
+                jnp.where(remaining[:, None, None], ac, jnp.zeros_like(ac)),
+                kc, COL_AXIS,
             )
         kr = k % g_a.pr
         ar = _spmd.take_row(a, k // g_a.pr, g_a)
         gj = _spmd.local_col_tiles(g_a, myc)
         rem_j = (gj > k) if forward else (gj < k)
-        rp = coll.psum_axis(
-            jnp.where((myr == kr) & rem_j[:, None, None], ar, jnp.zeros_like(ar)),
-            ROW_AXIS,
+        rp = coll.bcast(
+            jnp.where(rem_j[:, None, None], ar, jnp.zeros_like(ar)),
+            kr, ROW_AXIS,
         )
         cp = t.op_tile(coll.transpose_panel_rows(rp, g_a.mt, g_b.ltr), op)
         return jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
@@ -440,7 +429,7 @@ def triangular_solver(
         else None
     )
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), _spmd.trsm_trace_key(), g_a, g_b,
-           lookahead, ratio)
+           lookahead, ratio, coll.collectives_trace_key())
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

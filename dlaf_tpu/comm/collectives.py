@@ -15,13 +15,41 @@ Communicator pipelines/clones and MPI message ordering (communicator
 pipelines, §2.4 of SURVEY.md) have no analogue: XLA orders collectives by
 data flow and schedules independent ones concurrently.
 
+Implementation tiers
+--------------------
+Every redistribution here has exactly ONE contributor per output slot, so
+two implementations are interchangeable:
+
+* ``'psum'`` — the historical tier: ``lax.psum`` of root-masked / zero-padded
+  contributions.  Robust, but pays full all-reduce wire cost
+  (~``2(P-1)/P * payload`` on a ring) plus an add-tree over zeros.
+* ``'v2'`` — one-contributor redistributions as permutes: a doubling
+  ``lax.ppermute`` forward chain (``ceil(log2 P)`` rounds) carries the
+  payload from its unique source to every destination with no reduction at
+  all; out-of-range slots are zero-filled locally.  Semantically a true
+  broadcast (reference broadcast_panel.h / kernels/broadcast.h), modeled at
+  ``(P-1)/P * payload`` wire bytes per device — half the reduce tier.
+
+Selection: ``tune.TuneParameters.collectives_impl``
+(``'psum' | 'v2' | 'auto'``, env ``DLAF_TPU_COLLECTIVES_IMPL``; ``'auto'``
+= v2 on accelerator backends, psum on CPU until measured).  The knob is
+read at TRACE time — compiled-kernel caches must include
+:func:`collectives_trace_key` or flipping the knob would silently reuse
+stale executables.
+
 All functions assume they run inside ``shard_map`` over a mesh with axes
 ``('r', 'c')`` (see grid.ROW_AXIS/COL_AXIS).
 
 Every collective reports its payload to ``obs.comms`` at trace time (the
 ``_rec`` calls) — one ``is None`` test when accounting is off, and never a
 change to the traced computation (tests/test_obs.py asserts the lowered
-HLO is byte-identical either way).
+HLO is byte-identical either way).  The v2 primitives report distinct kinds
+(``bcast_v2``, ``transpose_panel_v2``) so the modeled wire-byte column in
+the metrics distinguishes reduce-tier from permute-tier traffic.
+
+Degenerate cases short-circuit to identity: a size-1 axis (single-row or
+single-column grid) and ``shift`` by a multiple of the axis size emit no
+collective ops at all (and report nothing — there is no traffic).
 """
 from __future__ import annotations
 
@@ -50,14 +78,80 @@ def grid_shape():
     return axis_size(ROW_AXIS), axis_size(COL_AXIS)
 
 
+# ------------------------------------------------------------ impl tiers
+
+
+def _impl() -> str:
+    """Resolve ``tune.collectives_impl`` to the active tier ('psum'|'v2').
+
+    ``'auto'`` picks v2 on accelerator backends and psum on CPU (where the
+    masked all-reduce benchmarks at parity and stays the measured default).
+    Read lazily so comm does not import tune at module load."""
+    from dlaf_tpu import tune
+
+    impl = tune.get_tune_parameters().collectives_impl
+    if impl == "auto":
+        return "v2" if jax.default_backend() != "cpu" else "psum"
+    if impl not in ("psum", "v2"):
+        raise ValueError(
+            f"collectives_impl must be 'psum', 'v2' or 'auto', got {impl!r}"
+        )
+    return impl
+
+
+def collectives_trace_key() -> str:
+    """The resolved implementation tier, for compiled-kernel cache keys.
+
+    Same rule as _spmd.trsm_trace_key: a knob outside the key is a dead
+    knob — flipping ``collectives_impl`` between calls must retrace, not
+    silently reuse an executable traced under the other tier."""
+    return _impl()
+
+
+def _forward_chain(y, have, axis: str):
+    """Doubling ``ppermute`` forward chain along ``axis``.
+
+    ``have`` is a bool array whose shape is a leading prefix of ``y``'s
+    (scalar for a whole-payload broadcast, per-slot vector for a panel
+    exchange).  Invariant per slot: ``have == True`` implies ``y`` holds
+    the true contributed value — a rank only takes an incoming value for a
+    slot it does not yet have, and only from a rank that has it, so
+    garbage is never marked valid.  After ``ceil(log2 P)`` rounds every
+    rank's ``have`` is the OR over the axis and every reachable slot is
+    filled; no reduction is ever issued."""
+    n = axis_size(axis)
+    s = 1
+    while s < n:
+        perm = [(i, (i + s) % n) for i in range(n)]
+        y_in = lax.ppermute(y, axis, perm)
+        h_in = lax.ppermute(have, axis, perm)
+        take = jnp.logical_and(jnp.logical_not(have), h_in)
+        take = take.reshape(take.shape + (1,) * (y.ndim - take.ndim))
+        y = jnp.where(take, y_in, y)
+        have = jnp.logical_or(have, h_in)
+        s *= 2
+    return y, have
+
+
+# ------------------------------------------------------------ primitives
+
+
 def bcast(x, root, axis: str):
     """Broadcast ``x`` from the device with ``axis_index(axis) == root`` to
     all devices along ``axis``.  ``root`` may be traced.
 
-    Implemented as a psum of root-masked data: O(log P) on ICI, no explicit
-    send/recv pairing (replaces schedule_bcast_send/recv)."""
-    _rec("bcast", x, axis)
+    psum tier: a psum of root-masked data — O(log P) on ICI, no explicit
+    send/recv pairing (replaces schedule_bcast_send/recv).  v2 tier: a
+    doubling ppermute chain seeded at the (traced) root — a true one-
+    contributor broadcast with no add-tree.  Size-1 axes are the identity."""
+    if axis_size(axis) == 1:
+        return x
     me = lax.axis_index(axis)
+    if _impl() == "v2":
+        _rec("bcast_v2", x, axis)
+        y, _ = _forward_chain(x, me == root, axis)
+        return y
+    _rec("bcast", x, axis)
     zero = jnp.zeros_like(x)
     return lax.psum(jnp.where(me == root, x, zero), axis)
 
@@ -68,6 +162,10 @@ def bcast2d(x, root_r, root_c):
 
 
 def psum_axis(x, axis: str):
+    """True all-reduce along ``axis`` (multi-contributor sums stay psum in
+    every tier).  Size-1 axes are the identity."""
+    if axis_size(axis) == 1:
+        return x
     _rec("psum", x, axis)
     return lax.psum(x, axis)
 
@@ -75,16 +173,21 @@ def psum_axis(x, axis: str):
 def shift(x, axis: str, offset: int = 1):
     """Ring shift along a grid axis: device i receives the value from device
     ``(i - offset) % P`` (replaces p2p send/recv chains; lax.ppermute rides
-    ICI neighbor links)."""
-    _rec("shift", x, axis)
+    ICI neighbor links).  A zero net offset (offset % P == 0, including any
+    offset on a size-1 axis) is the identity and emits nothing."""
     n = axis_size(axis)
+    if offset % n == 0:
+        return x
+    _rec("shift", x, axis)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
 
 def all_gather_axis(x, axis: str):
     """Gather local blocks along an axis; result has a new leading axis of
-    size P ordered by axis index."""
+    size P ordered by axis index.  Size-1 axes just add the leading axis."""
+    if axis_size(axis) == 1:
+        return x[None]
     _rec("all_gather", x, axis)
     return lax.all_gather(x, axis)
 
@@ -100,6 +203,27 @@ def select_local_tiles(panel_global, local_count: int, grid_dim, my_coord, src=0
     return jnp.where(valid, taken, jnp.zeros_like(taken))
 
 
+def _panel_exchange(taken, have, axis: str):
+    """Shared tail of the four ``transpose_panel*`` variants.
+
+    Each output slot has at most one contributing rank along ``axis`` —
+    marked per slot in ``have[slots]``, candidate value in
+    ``taken[slots, ...]`` (garbage where ``have`` is False).  Slots with no
+    contributor anywhere on the axis come out zero in both tiers (matching
+    the historical psum-of-masked-zeros semantics)."""
+    hmask = have.reshape(have.shape + (1,) * (taken.ndim - have.ndim))
+    if axis_size(axis) == 1:
+        return jnp.where(hmask, taken, jnp.zeros_like(taken))
+    if _impl() == "v2":
+        _rec("transpose_panel_v2", taken, axis)
+        y, have_all = _forward_chain(taken, have, axis)
+        amask = have_all.reshape(have_all.shape + (1,) * (y.ndim - have_all.ndim))
+        return jnp.where(amask, y, jnp.zeros_like(y))
+    contrib = jnp.where(hmask, taken, jnp.zeros_like(taken))
+    _rec("transpose_panel", contrib, axis)
+    return lax.psum(contrib, axis)
+
+
 def transpose_panel(cp, nr_row_tiles, ltc: int):
     """Column panel -> row panel redistribution.
 
@@ -110,7 +234,8 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     re-distributed along each rank's *column* ownership — the TPU analogue of
     the transposed-panel broadcast (reference broadcast_panel.h:116-189).
 
-    Cost: one psum over the row axis of ``ltc`` tiles.
+    Cost: one psum over the row axis of ``ltc`` tiles (psum tier), or a
+    log2(Pr)-round ppermute chain with no reduction (v2 tier).
     """
     myr, myc = my_rank()
     pr, pc = grid_shape()
@@ -118,11 +243,8 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     jv = jnp.arange(ltc) * pc + myc  # global tile index wanted at each slot
     src_slot = jnp.clip(jv // pr, 0, ltr - 1)
     have = (jv % pr == myr) & (jv < nr_row_tiles)
-    contrib = jnp.where(
-        have.reshape((ltc,) + (1,) * (cp.ndim - 1)), jnp.take(cp, src_slot, axis=0), 0
-    )
-    _rec("transpose_panel", contrib, ROW_AXIS)
-    return lax.psum(contrib, ROW_AXIS)
+    taken = jnp.take(cp, src_slot, axis=0)
+    return _panel_exchange(taken, have, ROW_AXIS)
 
 
 def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
@@ -135,13 +257,10 @@ def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
     myr, _ = my_rank()
     pr, _ = grid_shape()
     L = cp.shape[0]
-    C = jv.shape[0]
     src_slot = jv // pr - rs
     have = (jv % pr == myr) & (jv < nr_row_tiles) & (src_slot >= 0) & (src_slot < L)
     taken = jnp.take(cp, jnp.clip(src_slot, 0, L - 1), axis=0)
-    contrib = jnp.where(have.reshape((C,) + (1,) * (cp.ndim - 1)), taken, 0)
-    _rec("transpose_panel", contrib, ROW_AXIS)
-    return lax.psum(contrib, ROW_AXIS)
+    return _panel_exchange(taken, have, ROW_AXIS)
 
 
 def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
@@ -155,13 +274,10 @@ def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
     _, myc = my_rank()
     _, pc = grid_shape()
     C = rp.shape[0]
-    W = iv.shape[0]
     src_slot = iv // pc - cs
     have = (iv % pc == myc) & (iv < nr_col_tiles) & (src_slot >= 0) & (src_slot < C)
     taken = jnp.take(rp, jnp.clip(src_slot, 0, C - 1), axis=0)
-    contrib = jnp.where(have.reshape((W,) + (1,) * (rp.ndim - 1)), taken, 0)
-    _rec("transpose_panel", contrib, COL_AXIS)
-    return lax.psum(contrib, COL_AXIS)
+    return _panel_exchange(taken, have, COL_AXIS)
 
 
 def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
@@ -172,18 +288,15 @@ def transpose_panel_rows(rp, nr_col_tiles, ltr: int):
     this rank-column's global col-tiles ``j = lj*Pc + myc``.  Returns
     ``cp[ltr, ...]`` with ``cp[li] = panel tile of global index
     i = li*Pr + myr`` (zero where ``i >= nr_col_tiles``).  Cost: one psum over
-    the col axis."""
+    the col axis (psum tier) or a log2(Pc)-round ppermute chain (v2 tier)."""
     myr, myc = my_rank()
     pr, pc = grid_shape()
     ltc = rp.shape[0]
     iv = jnp.arange(ltr) * pr + myr
     src_slot = jnp.clip(iv // pc, 0, ltc - 1)
     have = (iv % pc == myc) & (iv < nr_col_tiles)
-    contrib = jnp.where(
-        have.reshape((ltr,) + (1,) * (rp.ndim - 1)), jnp.take(rp, src_slot, axis=0), 0
-    )
-    _rec("transpose_panel", contrib, COL_AXIS)
-    return lax.psum(contrib, COL_AXIS)
+    taken = jnp.take(rp, src_slot, axis=0)
+    return _panel_exchange(taken, have, COL_AXIS)
 
 
 def spmd(grid, fn, static_argnums=(), donate_argnums=(), out_specs=None):

@@ -25,6 +25,19 @@ Byte volumes are analytic: ``prod(shape) * dtype.itemsize`` of the operand
 handed to the ``lax`` collective — the logical payload, not a model of the
 algorithm XLA picks (recursive-halving psum etc. move different wire bytes;
 the logical volume is the stable, comparable figure).
+
+Modeled wire bytes
+------------------
+Next to the logical payload, each record carries an analytic ring-model
+wire cost per device (:func:`wire_model`), keyed on the collective *kind*:
+reduce-tier redistributions (``psum``/``bcast``/``transpose_panel``) cost a
+full all-reduce ``2(P-1)/P * payload``; the v2 one-contributor tier
+(``bcast_v2``/``transpose_panel_v2``) delivers each payload byte across
+``P-1`` links once, ``(P-1)/P * payload`` per device — the "modeled bytes
+saved" figure ``scripts/report_metrics.py`` prints is the difference.  It
+is a model of the semantic redistribution on a ring, deliberately NOT a
+count of the instructions XLA emits (which vary by backend and version);
+like the payload column it is exact, comparable, and hardware-free.
 """
 from __future__ import annotations
 
@@ -33,7 +46,8 @@ import math
 import numpy as np
 from jax import lax
 
-# (kind, dtype, axis, axis_size) -> [call_count, payload_bytes_total]
+# (kind, dtype, axis, axis_size) ->
+#     [call_count, payload_bytes_total, modeled_wire_bytes_total]
 _acc: dict | None = None
 
 
@@ -45,7 +59,7 @@ def start() -> None:
 
 def stop() -> dict:
     """Stop accounting and return {(kind, dtype, axis, axis_size):
-    [count, bytes]} in first-seen order."""
+    [count, bytes, modeled_wire_bytes]} in first-seen order."""
     global _acc
     acc, _acc = _acc or {}, None
     return acc
@@ -60,6 +74,28 @@ def snapshot() -> dict:
     return {k: list(v) for k, v in (_acc or {}).items()}
 
 
+def wire_model(kind: str, axis_size: int, nbytes: int) -> int:
+    """Analytic per-device ring wire bytes for one collective of ``kind``
+    with logical payload ``nbytes`` over ``axis_size`` participants.
+
+    Unknown axis contexts (axis_size 0) model as free — there is no ring to
+    cost.  Kinds: reduce-tier redistributions and true sums are ring
+    all-reduces; v2 one-contributor redistributions deliver each byte over
+    P-1 links once; ``shift`` is one neighbor hop; ``all_gather``
+    materializes the other P-1 blocks."""
+    p = int(axis_size)
+    if p <= 1:
+        return 0
+    if kind.endswith("_v2"):
+        return round((p - 1) * nbytes / p)
+    if kind == "shift":
+        return nbytes
+    if kind == "all_gather":
+        return (p - 1) * nbytes
+    # psum-lowered: psum / bcast / transpose_panel (ring all-reduce)
+    return round(2 * (p - 1) * nbytes / p)
+
+
 def record(kind: str, x, axis: str | None = None) -> None:
     """Account one collective call site: ``x`` is the operand about to be
     handed to the ``lax`` collective, ``axis`` its mesh axis (None for 2D /
@@ -72,22 +108,30 @@ def record(kind: str, x, axis: str | None = None) -> None:
         size = 0
     nbytes = math.prod(x.shape) * np.dtype(x.dtype).itemsize
     key = (kind, np.dtype(x.dtype).name, axis or "", int(size))
-    ent = _acc.setdefault(key, [0, 0])
+    ent = _acc.setdefault(key, [0, 0, 0])
     ent[0] += 1
     ent[1] += nbytes
+    ent[2] += wire_model(kind, int(size), nbytes)
 
 
 def as_records(acc: dict) -> list:
     """Render an accumulation dict into JSON-ready row dicts (one per
-    (kind, dtype, axis, axis_size) bucket)."""
-    return [
-        {
-            "collective": kind,
-            "dtype": dtype,
-            "axis": axis,
-            "axis_size": size,
-            "messages": count,
-            "bytes": nbytes,
-        }
-        for (kind, dtype, axis, size), (count, nbytes) in acc.items()
-    ]
+    (kind, dtype, axis, axis_size) bucket).  Accepts legacy two-element
+    values (pre-wire-model accumulations) and models their wire bytes on
+    the fly."""
+    rows = []
+    for (kind, dtype, axis, size), val in acc.items():
+        count, nbytes = val[0], val[1]
+        wire = val[2] if len(val) > 2 else wire_model(kind, size, nbytes)
+        rows.append(
+            {
+                "collective": kind,
+                "dtype": dtype,
+                "axis": axis,
+                "axis_size": size,
+                "messages": count,
+                "bytes": nbytes,
+                "modeled_wire_bytes": wire,
+            }
+        )
+    return rows

@@ -104,6 +104,17 @@ class TuneParameters:
       Pallas kernel (ops/pallas_secular.py — pole tables resident in VMEM
       across all rounds instead of one HBM read per round).  Default off,
       same gating; f32 paths only.
+    - ``collectives_impl``: implementation tier for the one-contributor
+      redistribution collectives (``comm.collectives``: bcast/bcast2d and
+      the transpose_panel family).  'psum' = the historical reduce tier
+      (masked all-reduce, ~2(P-1)/P wire bytes per device per payload);
+      'v2' = gather/permute tier (doubling ppermute chain, no add-tree,
+      modeled (P-1)/P wire bytes — half the reduce tier); 'auto'
+      (default) = v2 on accelerator backends, psum on CPU until measured.
+      The knob is read at trace time; every compiled-kernel cache keys on
+      the resolved tier (collectives.collectives_trace_key), so flipping
+      it between calls retraces correctly.  True multi-contributor sums
+      (psum_axis) are reductions in every tier.
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -139,6 +150,7 @@ class TuneParameters:
     # Pallas panel kernels (VERDICT r4 missing #6 / ROADMAP item 3): landed
     # CPU-validated (interpret-mode parity tests), DEFAULT OFF until an
     # on-hardware A/B justifies them — nothing lands unmeasured.
+    collectives_impl: str = field(default_factory=lambda: _env("collectives_impl", "auto", str))
     panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
     dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(

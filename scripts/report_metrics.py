@@ -90,19 +90,35 @@ def summarize(path: str) -> int:
 
     comms = by_kind.get("comms", [])
     if comms:
+        from dlaf_tpu.obs.comms import wire_model
+
         # aggregate across ranks/records: same key -> summed counts
-        agg = defaultdict(lambda: [0, 0])
+        agg = defaultdict(lambda: [0, 0, 0])
         for r in comms:
             for row in r["rows"]:
                 k = (row["collective"], row["dtype"], row["axis"], row["axis_size"])
                 agg[k][0] += row["messages"]
                 agg[k][1] += row["bytes"]
+                # pre-wire-model files lack the column: model it here
+                agg[k][2] += row.get(
+                    "modeled_wire_bytes", wire_model(k[0], k[3], row["bytes"])
+                )
         print(f"-- comms ({len(agg)} collective classes, trace-time counts):")
         print(f"   {'collective':18s} {'dtype':10s} {'axis':5s} "
-              f"{'P':>3s} {'msgs':>8s} {'payload':>10s}")
-        for (kind, dtype, axis, p), (msgs, nbytes) in sorted(agg.items()):
+              f"{'P':>3s} {'msgs':>8s} {'payload':>10s} {'wire(model)':>11s}")
+        total_wire = 0
+        saved = 0
+        for (kind, dtype, axis, p), (msgs, nbytes, wire) in sorted(agg.items()):
             print(f"   {kind:18s} {dtype:10s} {axis or '-':5s} "
-                  f"{p:3d} {msgs:8d} {_fmt_bytes(nbytes):>10s}")
+                  f"{p:3d} {msgs:8d} {_fmt_bytes(nbytes):>10s} "
+                  f"{_fmt_bytes(wire):>11s}")
+            total_wire += wire
+            if kind.endswith("_v2"):
+                # what the same payload would have cost on the reduce tier
+                saved += wire_model(kind[: -len("_v2")], p, nbytes) - wire
+        print(f"   modeled wire bytes total: {_fmt_bytes(total_wire)}"
+              + (f"  (saved {_fmt_bytes(saved)} vs reduce-tier collectives)"
+                 if saved else ""))
 
     compiles = by_kind.get("compile", [])
     if compiles:

@@ -137,7 +137,7 @@ def test_check_levels(grid_2x4, monkeypatch):
     try:
         _run_check_level_cases(checks, grid_2x4)
     finally:
-        checks.set_check_level(1)
+        checks.set_check_level(None)  # back to live env reads, not a sticky 1
 
 
 def _run_check_level_cases(checks, grid_2x4):

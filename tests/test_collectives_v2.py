@@ -1,0 +1,264 @@
+"""psum-tier vs v2-tier equivalence for the one-contributor collectives.
+
+The two implementation tiers of ``dlaf_tpu.comm.collectives`` (masked psum
+vs doubling-ppermute forward chain, selected by ``tune.collectives_impl``)
+must be BIT-identical on every grid shape — the v2 tier is a wire-cost
+optimization, not an approximation.  Property tests per primitive over
+{1x1, 1x2, 2x2, 2x4} x {f32, c64}, plus end-to-end POTRF/TRSM/TRTRI
+agreement on the 2x2 and 2x4 grids.
+"""
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu import tune
+from dlaf_tpu.comm import collectives as coll
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+SHAPES = [(1, 1), (1, 2), (2, 2), (2, 4)]
+DTYPES = [np.float32, np.complex64]
+
+
+@contextlib.contextmanager
+def _impl(value):
+    tp = tune.get_tune_parameters()
+    old = tp.collectives_impl
+    tp.update(collectives_impl=value)
+    try:
+        yield
+    finally:
+        tp.update(collectives_impl=old)
+
+
+def _grid(comm_grids, shape):
+    return next(g for g in comm_grids if tuple(g.grid_size) == shape)
+
+
+def _run(grid, fn, *args):
+    """Fresh jit per call (traces under the active impl; no cache reuse)."""
+    f = coll.spmd(grid, lambda *xs: coll.relocal(fn(*[coll.local(x) for x in xs])))
+    args = [jax.device_put(a, grid.stacked_sharding()) for a in args]
+    return np.asarray(f(*args))
+
+
+def _both_impls(grid, fn, *args):
+    with _impl("psum"):
+        ref = _run(grid, fn, *args)
+    with _impl("v2"):
+        out = _run(grid, fn, *args)
+    np.testing.assert_array_equal(ref, out)
+    return ref
+
+
+def _rand(shape, dtype, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal(shape)
+    if np.issubdtype(dtype, np.complexfloating):
+        x = x + 1j * rng.standard_normal(shape)
+    return x.astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bcast_equivalence(comm_grids, shape, dtype):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    x = _rand((pr, pc, 3, 4), dtype, seed=7)
+    for axis, root in ((COL_AXIS, pc - 1), (ROW_AXIS, 0), (COL_AXIS, 0)):
+        out = _both_impls(grid, lambda v: coll.bcast(v, root, axis), x)
+        # correctness against the replicated expectation, not just agreement
+        for r in range(pr):
+            for c in range(pc):
+                src = (r, root) if axis == COL_AXIS else (root, c)
+                np.testing.assert_array_equal(out[r, c], x[src])
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bcast_traced_root_equivalence(comm_grids, shape, dtype):
+    """Roots computed from a traced loop counter (the algorithms' k % P
+    pattern) must agree between tiers too."""
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    x = _rand((pr, pc, 2, 3), dtype, seed=11)
+
+    def fn(v):
+        k = jnp.sum(jnp.ones((), jnp.int32))  # traced 1
+        return coll.bcast(v, k % pc, COL_AXIS)
+
+    out = _both_impls(grid, fn, x)
+    for r in range(pr):
+        for c in range(pc):
+            np.testing.assert_array_equal(out[r, c], x[r, 1 % pc])
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_bcast2d_equivalence(comm_grids, shape, dtype):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    x = _rand((pr, pc, 4), dtype, seed=13)
+    out = _both_impls(grid, lambda v: coll.bcast2d(v, pr - 1, pc - 1), x)
+    assert (out == x[pr - 1, pc - 1]).all()
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_transpose_panel_equivalence(comm_grids, shape, dtype):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    mt = 5  # ragged vs both pr and pc
+    ltr, ltc, mb = -(-mt // pr), -(-mt // pc), 2
+    x = _rand((pr, pc, ltr, mb, mb), dtype, seed=17)
+    out = _both_impls(grid, lambda cp: coll.transpose_panel(cp, mt, ltc), x)
+    # contributor for slot lj in column c is rank row jv % pr with its own cp
+    for r in range(pr):
+        for c in range(pc):
+            for lj in range(ltc):
+                j = lj * pc + c
+                if j < mt:
+                    want = x[j % pr, c, min(j // pr, ltr - 1)]
+                else:
+                    want = np.zeros((mb, mb), dtype)
+                np.testing.assert_array_equal(out[r, c, lj], want)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_transpose_panel_rows_equivalence(comm_grids, shape, dtype):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    nt = 5
+    ltr, ltc, mb = -(-nt // pr), -(-nt // pc), 2
+    x = _rand((pr, pc, ltc, mb, mb), dtype, seed=19)
+    out = _both_impls(grid, lambda rp: coll.transpose_panel_rows(rp, nt, ltr), x)
+    for r in range(pr):
+        for c in range(pc):
+            for li in range(ltr):
+                i = li * pr + r
+                if i < nt:
+                    want = x[r, i % pc, min(i // pc, ltc - 1)]
+                else:
+                    want = np.zeros((mb, mb), dtype)
+                np.testing.assert_array_equal(out[r, c, li], want)
+
+
+@pytest.mark.parametrize("rs", [0, 1])
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_transpose_panel_windowed_equivalence(comm_grids, shape, dtype, rs):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    mt = 5
+    ltr, ltc, mb = -(-mt // pr), -(-mt // pc), 2
+    L = max(ltr - rs, 1)
+    x = _rand((pr, pc, L, mb, mb), dtype, seed=23 + rs)
+
+    def fn(cp):
+        _, myc = coll.my_rank()
+        jv = jnp.arange(ltc) * pc + myc
+        return coll.transpose_panel_windowed(cp, jv, rs, mt)
+
+    _both_impls(grid, fn, x)
+
+
+@pytest.mark.parametrize("cs", [0, 1])
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_transpose_panel_rows_windowed_equivalence(comm_grids, shape, dtype, cs):
+    grid = _grid(comm_grids, shape)
+    pr, pc = shape
+    nt = 5
+    ltr, ltc, mb = -(-nt // pr), -(-nt // pc), 2
+    C = max(ltc - cs, 1)
+    x = _rand((pr, pc, C, mb, mb), dtype, seed=29 + cs)
+
+    def fn(rp):
+        myr, _ = coll.my_rank()
+        iv = jnp.arange(ltr) * pr + myr
+        return coll.transpose_panel_rows_windowed(rp, iv, cs, nt)
+
+    _both_impls(grid, fn, x)
+
+
+# --------------------------- end-to-end drivers ---------------------------
+
+
+E2E_SHAPES = [(2, 2), (2, 4)]
+
+
+def _factor_both(run):
+    with _impl("psum"):
+        ref = run()
+    with _impl("v2"):
+        out = run()
+    np.testing.assert_array_equal(ref, out)
+
+
+@pytest.mark.parametrize("shape", E2E_SHAPES)
+def test_cholesky_psum_vs_v2(comm_grids, shape):
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+
+    grid = _grid(comm_grids, shape)
+    a = tu.random_hermitian_pd(40, np.float32, seed=31)
+
+    def run():
+        mat = DistributedMatrix.from_global(grid, np.tril(a), (8, 8))
+        return cholesky_factorization("L", mat).to_global()
+
+    _factor_both(run)
+
+
+@pytest.mark.parametrize("shape", E2E_SHAPES)
+def test_trsm_psum_vs_v2(comm_grids, shape):
+    from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+
+    grid = _grid(comm_grids, shape)
+    a = np.tril(tu.random_matrix(40, 40, np.float32, seed=37)) + 40 * np.eye(
+        40, dtype=np.float32
+    )
+    b = tu.random_matrix(40, 24, np.float32, seed=41)
+
+    def run():
+        mat_a = DistributedMatrix.from_global(grid, a, (8, 8))
+        mat_b = DistributedMatrix.from_global(grid, b, (8, 8))
+        return triangular_solver(
+            t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_a, mat_b
+        ).to_global()
+
+    _factor_both(run)
+
+
+@pytest.mark.parametrize("shape", E2E_SHAPES)
+def test_trtri_psum_vs_v2(comm_grids, shape):
+    from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+    grid = _grid(comm_grids, shape)
+    a = np.tril(tu.random_matrix(40, 40, np.float32, seed=43)) + 40 * np.eye(
+        40, dtype=np.float32
+    )
+
+    def run():
+        mat = DistributedMatrix.from_global(grid, a, (8, 8))
+        return triangular_inverse("L", t.NON_UNIT, mat).to_global()
+
+    _factor_both(run)
+
+
+def test_invalid_impl_raises(comm_grids):
+    grid = _grid(comm_grids, (2, 2))
+    x = np.zeros((2, 2, 1), np.float32)
+    with _impl("bogus"):
+        with pytest.raises(ValueError, match="collectives_impl"):
+            _run(grid, lambda v: coll.bcast(v, 0, COL_AXIS), x)
+
+
+def test_auto_resolves_psum_on_cpu():
+    with _impl("auto"):
+        assert coll.collectives_trace_key() == "psum"

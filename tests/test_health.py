@@ -178,6 +178,7 @@ def test_shift_recovery_preserves_original_buffer(grid_2x4):
 
 
 def test_check_level_rereads_env(monkeypatch):
+    checks.set_check_level(None)  # drop any override a prior test left behind
     try:
         monkeypatch.setenv("DLAF_TPU_CHECK_LEVEL", "0")
         assert checks.check_level() == 0

@@ -13,6 +13,7 @@ The load-bearing invariants:
   (jax.named_scope inside the shard_map bodies), giving profiler traces
   the same vocabulary as the stagetimer.
 """
+import contextlib
 import json
 
 import numpy as np
@@ -65,7 +66,7 @@ def test_metrics_schema_roundtrip(tmp_path):
     rows = recs[5]["rows"]
     assert rows == [
         {"collective": "psum", "dtype": "float32", "axis": "",
-         "axis_size": 0, "messages": 1, "bytes": 64}
+         "axis_size": 0, "messages": 1, "bytes": 64, "modeled_wire_bytes": 0}
     ]
 
 
@@ -94,7 +95,13 @@ def test_metrics_off_is_noop(tmp_path):
 # ------------------------------------------------------------- comms math
 
 
-def test_comms_byte_math(grid_2x4):
+@pytest.mark.parametrize("impl,bkind,bwire_of", [
+    # [messages, payload bytes, modeled wire bytes]; wire models:
+    # reduce tier 2(P-1)/P * payload, permute tier (P-1)/P * payload
+    ("psum", "bcast", lambda nb: nb),            # P=2: 2*(1/2)*nb
+    ("v2", "bcast_v2", lambda nb: round(nb / 2)),  # P=2: (1/2)*nb
+])
+def test_comms_byte_math(grid_2x4, impl, bkind, bwire_of):
     mat = DistributedMatrix.zeros(grid_2x4, (16, 16), (4, 4), np.float32)
     nloc = int(np.prod(mat.data.shape[2:]))  # per-device block elements
 
@@ -105,17 +112,46 @@ def test_comms_byte_math(grid_2x4):
         return coll.relocal(y)
 
     ocomms.start()
-    out = coll.spmd(grid_2x4, fn)(mat.data)
-    out.block_until_ready()
+    with _collectives_impl(impl):
+        out = coll.spmd(grid_2x4, fn)(mat.data)
+        out.block_until_ready()
     acc = ocomms.stop()
     assert acc == {
-        ("psum", "float32", COL_AXIS, 4): [1, nloc * 4],
-        ("bcast", "float32", ROW_AXIS, 2): [1, nloc * 4],
+        ("psum", "float32", COL_AXIS, 4): [1, nloc * 4, round(1.5 * nloc * 4)],
+        (bkind, "float32", ROW_AXIS, 2): [1, nloc * 4, bwire_of(nloc * 4)],
     }
     rows = ocomms.as_records(acc)
-    assert {r["collective"] for r in rows} == {"psum", "bcast"}
+    assert {r["collective"] for r in rows} == {"psum", bkind}
     for r in rows:
         assert r["bytes"] == nloc * 4 and r["messages"] == 1
+        assert r["modeled_wire_bytes"] > 0
+
+
+def test_comms_legacy_two_element_rows():
+    """as_records must keep accepting pre-wire-model accumulators (older
+    pickled/forwarded dicts carry [messages, bytes] only): the modeled
+    column is recomputed from the wire model on the fly."""
+    acc = {("psum", "float32", COL_AXIS, 4): [2, 128]}
+    (row,) = ocomms.as_records(acc)
+    assert row["messages"] == 2 and row["bytes"] == 128
+    assert row["modeled_wire_bytes"] == ocomms.wire_model("psum", 4, 128)
+
+
+def test_wire_model_v2_halves_reduce_tier():
+    """The analytic claim behind the v2 tier: a one-contributor
+    redistribution costs (P-1)/P * payload on a ring — exactly half the
+    2(P-1)/P all-reduce figure the psum tier pays."""
+    for p in (2, 4, 8):
+        for nbytes in (64, 1000):
+            red = ocomms.wire_model("bcast", p, nbytes)
+            v2 = ocomms.wire_model("bcast_v2", p, nbytes)
+            assert red == round(2 * (p - 1) * nbytes / p)
+            assert v2 == round((p - 1) * nbytes / p)
+            assert ocomms.wire_model("transpose_panel", p, nbytes) == red
+            assert ocomms.wire_model("transpose_panel_v2", p, nbytes) == v2
+    # degenerate axes move nothing in any tier
+    assert ocomms.wire_model("bcast", 1, 4096) == 0
+    assert ocomms.wire_model("bcast_v2", 1, 4096) == 0
 
 
 def test_comms_accounting_leaves_hlo_unchanged(grid_2x4):
@@ -138,6 +174,76 @@ def test_comms_accounting_leaves_hlo_unchanged(grid_2x4):
     acc = ocomms.stop()
     assert txt_on == txt_off
     assert ("psum", "float32", COL_AXIS, 4) in acc  # it did account
+
+
+@contextlib.contextmanager
+def _collectives_impl(value):
+    from dlaf_tpu import tune
+
+    tp = tune.get_tune_parameters()
+    old = tp.collectives_impl
+    tp.update(collectives_impl=value)
+    try:
+        yield
+    finally:
+        tp.update(collectives_impl=old)
+
+
+def test_comms_accounting_leaves_v2_hlo_unchanged(grid_2x4):
+    """Same byte-identical guarantee for the v2 permute-tier primitives:
+    the _rec calls on the bcast_v2 / transpose_panel_v2 paths are trace-time
+    Python only."""
+    mat = DistributedMatrix.zeros(grid_2x4, (16, 16), (4, 4), np.float32)
+
+    def make():
+        def fn(x):
+            y = coll.local(x)
+            y = coll.bcast(y, 1, COL_AXIS)
+            y = coll.transpose_panel(y, 4, 1)
+            return coll.relocal(y)
+
+        return coll.spmd(grid_2x4, fn)
+
+    with _collectives_impl("v2"):
+        txt_off = make().lower(mat.data).as_text()
+        ocomms.start()
+        txt_on = make().lower(mat.data).as_text()
+        acc = ocomms.stop()
+    assert txt_on == txt_off
+    assert ("bcast_v2", "float32", COL_AXIS, 4) in acc
+    assert ("transpose_panel_v2", "float32", ROW_AXIS, 2) in acc
+
+
+def test_potrf_modeled_wire_bytes_drop_under_v2(grid_2x4, tmp_path):
+    """The headline claim of the v2 tier: distributed POTRF's modeled wire
+    bytes drop by >= 40% vs the psum tier (every collective in the POTRF
+    kernel is a one-contributor redistribution, so the ring model halves).
+    Asserted on the emitted metrics JSONL, not just the in-process dict."""
+    from dlaf_tpu.algorithms import cholesky as C
+
+    a = np.tril(tu.random_hermitian_pd(24, np.float32, seed=9))
+
+    def wire_total(impl, path):
+        # accounting records at TRACE time: drop cached executables so the
+        # kernel actually retraces under this impl
+        C._kernel_cache.clear()
+        om.enable(path)
+        ocomms.start()
+        with _collectives_impl(impl):
+            mat = DistributedMatrix.from_global(grid_2x4, a, (4, 4))
+            out = C.cholesky_factorization("L", mat, backend="distributed")
+            out.data.block_until_ready()
+        om.emit_comms(ocomms.stop())
+        om.close()
+        rows = [r for rec in om.read_jsonl(path) if rec["kind"] == "comms"
+                for r in rec["rows"]]
+        assert rows
+        return sum(r["modeled_wire_bytes"] for r in rows)
+
+    psum_total = wire_total("psum", str(tmp_path / "psum.jsonl"))
+    v2_total = wire_total("v2", str(tmp_path / "v2.jsonl"))
+    assert psum_total > 0
+    assert v2_total <= 0.6 * psum_total, (v2_total, psum_total)
 
 
 # ------------------------------------------------------------- trace scopes
