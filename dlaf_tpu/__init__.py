@@ -13,6 +13,8 @@ from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Index2D, Size2D
 from dlaf_tpu.health import (
     ConvergenceError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
     DistributionError,
     DlafError,
     NonFiniteError,
@@ -72,6 +74,8 @@ __all__ = [
     "ConvergenceError",
     "DistributionError",
     "NonFiniteError",
+    "DeadlineExceededError",
+    "DeviceUnresponsiveError",
     "Distribution",
     "DistributedMatrix",
     "MatrixRef",

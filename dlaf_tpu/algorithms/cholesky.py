@@ -91,6 +91,52 @@ def _pivot_scan(d):
     return bad
 
 
+def _chol_step(k, x, info, g: _spmd.Geometry, myr, myc, gi, want_info: bool):
+    """One right-looking Cholesky panel step on the padded local tile stack
+    ``x`` (diag potrf -> panel trsm -> broadcasts -> write-back -> trailing
+    update).  Shared by the masked full-loop kernel and the checkpointing
+    range kernel so both trace IDENTICAL per-step computation — the
+    foundation of the resumed-run bit-exactness contract.  Returns
+    ``(x, info)``; ``info`` is passed through untouched when ``want_info``
+    is off (the caller drops it)."""
+    kc = k % g.pc
+    lkc = k // g.pc
+    # 1. diagonal tile to everyone; redundant local potrf
+    with _scope("chol.diag_potrf"):
+        d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
+        lkk = _diag_potrf(d)
+        if want_info:
+            bad = _pivot_scan(d)
+            # cast: k is the loop-index dtype (int64 in the range kernel
+            # under x64), the info carry stays int32
+            info = jnp.where(
+                (info == 0) & (bad > 0), (k * g.mb + bad).astype(info.dtype), info
+            )
+    # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
+    with _scope("chol.panel_trsm"):
+        xc = _spmd.take_col(x, lkc, g)
+        pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
+        below = (gi > k)[:, None, None]
+        cp_own = jnp.where(below, pan, jnp.zeros_like(pan))
+    # 3. column panel to all rank columns; transposed row panel
+    # (one-contributor broadcast from rank column kc; the `below` mask
+    # zeroes non-panel rows on the root before the wire)
+    with _scope("chol.panel_bcast"):
+        cp = coll.bcast(cp_own, kc, COL_AXIS)  # [ltr, mb, mb]
+        rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
+    # write back the factored column (pivot tile + sub-diagonal tiles)
+    new_col = jnp.where(
+        myc == kc,
+        jnp.where((gi == k)[:, None, None], lkk[None], jnp.where(below, pan, xc)),
+        xc,
+    )
+    x = _spmd.put_col(x, new_col, lkc)
+    # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
+    with _scope("chol.trailing_update"):
+        x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+    return x, info
+
+
 def _chol_L_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     """shard_map-local kernel: x is [1,1,ltr,ltc,mb,mb]; returns same — or,
     with ``want_info``, (same, info) with ``info`` the LAPACK-style 1-based
@@ -106,37 +152,7 @@ def _chol_L_kernel(x, g: _spmd.Geometry, want_info: bool = False):
 
     def body(k, carry):
         x, info = carry if want_info else (carry, None)
-        kr, kc = k % g.pr, k % g.pc
-        lkc = k // g.pc
-        # 1. diagonal tile to everyone; redundant local potrf
-        with _scope("chol.diag_potrf"):
-            d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-            lkk = _diag_potrf(d)
-            if want_info:
-                bad = _pivot_scan(d)
-                info = jnp.where((info == 0) & (bad > 0), k * g.mb + bad, info)
-        # 2. panel trsm: L[i,k] = A[i,k] @ L[k,k]^-H for local rows i > k
-        with _scope("chol.panel_trsm"):
-            xc = _spmd.take_col(x, lkc, g)
-            pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
-            below = (gi > k)[:, None, None]
-            cp_own = jnp.where(below, pan, jnp.zeros_like(pan))
-        # 3. column panel to all rank columns; transposed row panel
-        # (one-contributor broadcast from rank column kc; the `below` mask
-        # zeroes non-panel rows on the root before the wire)
-        with _scope("chol.panel_bcast"):
-            cp = coll.bcast(cp_own, kc, COL_AXIS)  # [ltr, mb, mb]
-            rp = coll.transpose_panel(cp, g.mt, g.ltc)  # [ltc, mb, mb]
-        # write back the factored column (pivot tile + sub-diagonal tiles)
-        new_col = jnp.where(
-            myc == kc,
-            jnp.where((gi == k)[:, None, None], lkk[None], jnp.where(below, pan, xc)),
-            xc,
-        )
-        x = _spmd.put_col(x, new_col, lkc)
-        # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
-        with _scope("chol.trailing_update"):
-            x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+        x, info = _chol_step(k, x, info, g, myr, myc, gi, want_info)
         return (x, info) if want_info else x
 
     init = (x, jnp.zeros((), jnp.int32)) if want_info else x
@@ -144,6 +160,34 @@ def _chol_L_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     x, info = out if want_info else (out, None)
     x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
     return (coll.relocal(x), info) if want_info else coll.relocal(x)
+
+
+def _chol_L_range_kernel(x, info, k0, k1, g: _spmd.Geometry):
+    """Checkpoint-segment kernel: run panel steps ``k0 <= k < k1`` of the
+    masked L factorization (``_chol_step``, info always carried).  ``k0``
+    and ``k1`` are TRACED scalars — ``lax.fori_loop`` accepts dynamic
+    bounds — so ONE compiled executable serves every segment of a
+    ``checkpoint_every=`` run and every resumed continuation; resumed and
+    uninterrupted runs of the same cadence replay the identical executable
+    over identical panel ranges, which is what makes the restored factor
+    bit-exact.  Padding is applied/removed per segment: padding tiles never
+    feed real output entries (real tiles only read real panel entries), so
+    segmenting is value-exact on the logical matrix."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    x = _spmd.pad_diag_identity(x, g, myr, myc)
+    gi = _spmd.local_row_tiles(g, myr)
+
+    def body(k, carry):
+        return _chol_step(k, carry[0], carry[1], g, myr, myc, gi, True)
+
+    # bounds cast to the DEFAULT int dtype so the loop index k matches the
+    # full-loop kernels' weak-int index (int64 under x64) — the _spmd slice
+    # helpers mix k-derived offsets with python-int literals
+    idt = jnp.asarray(0).dtype
+    x, info = lax.fori_loop(k0.astype(idt), k1.astype(idt), body, (x, info))
+    x = _spmd.pad_diag_identity(x, g, myr, myc, remove=True)
+    return coll.relocal(x), info
 
 
 def _chol_L_bucketed_kernel(x, g: _spmd.Geometry, want_info: bool = False):
@@ -317,6 +361,67 @@ def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
     return _kernel_cache[key]
 
 
+_range_cache = {}
+
+
+def _compiled_range(grid, g: _spmd.Geometry):
+    """Compiled checkpoint-segment executable for the masked L kernel:
+    ``(x, info, k0, k1) -> (x, info)`` with traced panel bounds, so the
+    one executable serves every segment and every resumed continuation.
+    Built directly on ``shard_map_compat`` (not :func:`coll.spmd`, whose
+    uniform ``P('r','c')`` in_specs would shard the scalar bounds)."""
+    key = (grid.cache_key, g, _spmd.trsm_trace_key(), coll.collectives_trace_key())
+    if key not in _range_cache:
+        P = jax.sharding.PartitionSpec
+        spec = P(ROW_AXIS, COL_AXIS)
+        sm = coll.shard_map_compat(
+            partial(_chol_L_range_kernel, g=g),
+            mesh=grid.mesh,
+            in_specs=(spec, P(), P(), P()),
+            out_specs=(spec, P()),
+        )
+        _range_cache[key] = jax.jit(sm, donate_argnums=(0,))
+    return _range_cache[key]
+
+
+def _factor_checkpointed(mat_a, g: _spmd.Geometry, checkpoint_every: int,
+                         checkpoint_path, resume_from):
+    """Segmented L factorization: run the range kernel ``checkpoint_every``
+    panels at a time, crossing a ``resilience.panel_boundary`` (deadline
+    check / fault-injection point) before each segment and writing a
+    panel-granular checkpoint after each completed segment when
+    ``checkpoint_path`` is set (no path: segmented execution only — how an
+    uninterrupted reference run matches a resumed run's cadence).  With
+    ``resume_from`` the matrix state and panel index are restored first and
+    the loop re-enters at the stored panel.  Returns ``(data, info)``;
+    ``mat_a`` is repointed at every segment so the caller's handle survives
+    a preemption mid-loop."""
+    from dlaf_tpu import resilience
+
+    kern = _compiled_range(mat_a.grid, g)
+    step = int(checkpoint_every) if checkpoint_every else g.mt
+    k = 0
+    info = jnp.zeros((), jnp.int32)
+    if resume_from is not None:
+        data, attrs, _ = resilience.load_checkpoint(
+            resume_from, mat_a, algo="cholesky"
+        )
+        mat_a._inplace(data)
+        k = int(attrs.get("panel", 0))
+        info = jnp.asarray(np.int32(attrs.get("info", 0)))
+    while k < g.mt:
+        k1 = min(k + step, g.mt)
+        resilience.panel_boundary("cholesky", k, mat_a.data)
+        data, info = kern(mat_a.data, info, np.int32(k), np.int32(k1))
+        mat_a._inplace(data)
+        k = k1
+        if checkpoint_path is not None and k < g.mt:
+            resilience.save_checkpoint(
+                checkpoint_path, mat_a, algo="cholesky", panel=k, info=int(info)
+            )
+    return mat_a.data, info
+
+
 _local_cache = {}
 
 
@@ -400,6 +505,9 @@ def cholesky_factorization(
     raise_on_failure: bool = False,
     shift_recovery: bool = False,
     max_shift_attempts: int = 3,
+    checkpoint_every: int = 0,
+    checkpoint_path: str | None = None,
+    resume_from: str | None = None,
 ) -> DistributedMatrix:
     """Factor the Hermitian positive-definite ``mat_a``: on return the
     ``uplo`` triangle holds the Cholesky factor.  Only the ``uplo`` triangle
@@ -429,10 +537,36 @@ def cholesky_factorization(
     Info-code requests route 1x1 grids through the distributed kernel too:
     the dense XLA fast path NaN-fills its whole factor on failure and
     cannot name the pivot.
+
+    Preemption safety (``dlaf_tpu.resilience``):
+
+    * ``checkpoint_every=k`` — run the factorization in k-panel segments;
+      after each completed segment write a panel-granular checkpoint to
+      ``checkpoint_path`` (matrix state + panel index + tune/collectives
+      snapshot, atomic rank-0 HDF5 write).  Collective-safe: on
+      multi-process worlds every process must make the same call.  Without
+      ``checkpoint_path`` the run is merely segmented — how an
+      uninterrupted reference run matches a resumed run's cadence.
+    * ``resume_from=path`` — restore a checkpoint and re-enter the panel
+      loop at the stored panel.  A resumed run is BIT-IDENTICAL to an
+      uninterrupted run of the same ``checkpoint_every`` cadence (both
+      replay the one compiled range kernel over the same panel ranges).
+    * Each segment boundary is a ``resilience.panel_boundary``: ambient
+      ``resilience.deadline`` budgets are enforced there
+      (:class:`~dlaf_tpu.health.DeadlineExceededError` instead of an
+      unbounded block) and fault injection (simulated preemption) hooks in
+      there.  Checkpointing forces the distributed kernel (the dense 1x1
+      fast path has no panel loop) and excludes ``shift_recovery``.
     """
     from dlaf_tpu.health import DistributionError, NotPositiveDefiniteError
 
     want_info = return_info or raise_on_failure or shift_recovery
+    ckpt = bool(checkpoint_every) or checkpoint_path is not None or resume_from is not None
+    if ckpt and shift_recovery:
+        raise DistributionError(
+            "cholesky: checkpointing and shift_recovery are mutually exclusive "
+            "(recovery restarts from the original matrix, not a checkpoint)"
+        )
     if mat_a.size.rows != mat_a.size.cols:
         raise DistributionError("cholesky: matrix must be square")
     if mat_a.block_size.rows != mat_a.block_size.cols:
@@ -447,7 +581,8 @@ def cholesky_factorization(
         from dlaf_tpu.matrix.io import maybe_dump
 
         maybe_dump("debug_dump_cholesky_data", "dlaf_dump_cholesky_input.npz", mat_a)
-    if backend == "auto" and mat_a.grid.grid_size.count() == 1 and not want_info:
+    if (backend == "auto" and mat_a.grid.grid_size.count() == 1
+            and not want_info and not ckpt):
         with obs.stage("potrf"):
             out = _cholesky_single_device(uplo, mat_a)
             st.barrier(out.data)
@@ -460,7 +595,11 @@ def cholesky_factorization(
 
         shift = 0.0
         with obs.stage("potrf"), blas3_precision():
-            if shift_recovery:
+            if ckpt:
+                data, info = _factor_checkpointed(
+                    mat_a, g, checkpoint_every, checkpoint_path, resume_from
+                )
+            elif shift_recovery:
                 data, info, shift = _factor_with_recovery(
                     mat_a, g, variant, max_shift_attempts
                 )
@@ -496,6 +635,9 @@ def cholesky_factorization(
             raise_on_failure=raise_on_failure,
             shift_recovery=shift_recovery,
             max_shift_attempts=max_shift_attempts,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=checkpoint_path,
+            resume_from=resume_from,
         )
         fac, info = res if want_info else (res, None)
         u = mutil.transpose(mutil.extract_triangle(fac, "L"), conj=True)

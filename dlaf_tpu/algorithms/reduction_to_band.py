@@ -144,96 +144,192 @@ def _t_factor(v, taus, nb: int):
     return jnp.where((taus == 0)[None, :], 0, tmat)
 
 
+def _red2band_step(p, carry, g: _spmd.Geometry, band: int, myr, myc, L: int, C: int):
+    """One band-panel step (gather -> Householder panel -> T factor ->
+    two-sided trailing update on an L x C window -> write-back) on the
+    shard_map-local tile stack.  Shared by the bucketed full-loop kernel
+    (shrinking windows per segment) and the checkpointing range kernel
+    (full windows — V is zero outside the trailing region, so the wider
+    window is value-exact).  carry = (x, taus_all)."""
+    np_ = g.ltr * g.pr * g.mb  # padded global rows
+    mt_pad = np_ // g.mb
+    x, taus_all = carry
+    pb = p * band  # first panel column (global element)
+    kt = pb // g.nb  # tile column holding the panel
+    co = pb % g.nb  # column offset inside that tile
+    kc = kt % g.pc
+    lkc = kt // g.pc
+    # 1. gather the band-wide panel strip to every rank (O(N band) data)
+    with _scope("red2band.panel_gather"):
+        xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
+        xcb = lax.dynamic_slice(xc, (0, 0, co), (g.ltr, g.mb, band))
+        gat = coll.all_gather_axis(xcb, ROW_AXIS)  # [pr, ltr, mb, band]
+        col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, band)
+        col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
+        pnl = col_tiles.reshape(np_, band)
+    start = (p + 1) * band  # first eliminated row
+    with _scope("red2band.hh_panel"):
+        p_out, v, taus = _hh_panel(pnl, start, band, np_, g.m)
+        taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (p, 0))
+    # 2. T factor (replicated)
+    with _scope("red2band.t_factor"):
+        tmat = _t_factor(v, taus, band)
+    # 3. two-sided trailing update on the bucketed window (static L x C):
+    # V is zero outside the trailing region, so clamped window overlap
+    # contributes nothing — same safety argument as cholesky bucketing
+    v_tiles = v.reshape(mt_pad, g.mb, band)
+    t0 = start // g.mb  # first tile row/col with reflector data
+    rs = jnp.clip((t0 + g.pr - 1 - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(
+        jnp.asarray(p).dtype
+    )
+    cs = jnp.clip((t0 + g.pc - 1 - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(
+        jnp.asarray(p).dtype
+    )
+    gi_w = (rs + jnp.arange(L)) * g.pr + myr
+    gj_w = (cs + jnp.arange(C)) * g.pc + myc
+    vr = jnp.take(v_tiles, gi_w, axis=0)  # [L, mb, band] (gi_w < mt_pad)
+    valid_c = (gj_w < mt_pad)[:, None, None]
+    vc = jnp.where(
+        valid_c, jnp.take(v_tiles, jnp.clip(gj_w, 0, mt_pad - 1), axis=0), 0
+    )  # [C, mb, band]
+    with _scope("red2band.trailing_update"):
+        xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
+        xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
+        xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
+        xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
+        mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
+        mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
+        w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
+        # mask W2 to the trailing region (element rows >= start)
+        ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
+        w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
+        w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
+        xs = (
+            xs
+            - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
+            - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
+        )
+        x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
+    # 4. write the factored panel strip back (element rows >= start on
+    # the owning tile column; start is generally NOT tile-aligned)
+    p_tiles = p_out.reshape(mt_pad, g.mb, band)
+    gi = _spmd.local_row_tiles(g, myr)
+    newcol_b = jnp.take(p_tiles, gi, axis=0)  # [ltr, mb, band]
+    ge_rows = gi[:, None] * g.mb + jnp.arange(g.mb)[None, :]
+    write = (ge_rows >= start)[:, :, None] & (myc == kc)
+    xc_now = _spmd.take_col(x, lkc, g)
+    cur_b = lax.dynamic_slice(xc_now, (0, 0, co), (g.ltr, g.mb, band))
+    new_b = jnp.where(write, newcol_b, cur_b)
+    xc_new = lax.dynamic_update_slice(xc_now, new_b, (0, 0, co))
+    x = _spmd.put_col(x, xc_new, lkc)
+    return x, taus_all
+
+
 def _red2band_kernel(x, g: _spmd.Geometry, n_panels: int, band: int):
     x = coll.local(x)
     myr, myc = coll.my_rank()
-    np_ = g.ltr * g.pr * g.mb  # padded global rows
-    mt_pad = np_ // g.mb
     taus_all = jnp.zeros((n_panels, band), x.dtype)
-
-    def body(p, carry, L, C):
-        x, taus_all = carry
-        pb = p * band  # first panel column (global element)
-        kt = pb // g.nb  # tile column holding the panel
-        co = pb % g.nb  # column offset inside that tile
-        kc = kt % g.pc
-        lkc = kt // g.pc
-        # 1. gather the band-wide panel strip to every rank (O(N band) data)
-        with _scope("red2band.panel_gather"):
-            xc = _spmd.take_col(x, lkc, g)  # [ltr, mb, nb]
-            xcb = lax.dynamic_slice(xc, (0, 0, co), (g.ltr, g.mb, band))
-            gat = coll.all_gather_axis(xcb, ROW_AXIS)  # [pr, ltr, mb, band]
-            col_tiles = jnp.transpose(gat, (1, 0, 2, 3)).reshape(mt_pad, g.mb, band)
-            col_tiles = coll.bcast(col_tiles, kc, COL_AXIS)
-            pnl = col_tiles.reshape(np_, band)
-        start = (p + 1) * band  # first eliminated row
-        with _scope("red2band.hh_panel"):
-            p_out, v, taus = _hh_panel(pnl, start, band, np_, g.m)
-            taus_all = lax.dynamic_update_slice(taus_all, taus[None, :], (p, 0))
-        # 2. T factor (replicated)
-        with _scope("red2band.t_factor"):
-            tmat = _t_factor(v, taus, band)
-        # 3. two-sided trailing update on the bucketed window (static L x C):
-        # V is zero outside the trailing region, so clamped window overlap
-        # contributes nothing — same safety argument as cholesky bucketing
-        v_tiles = v.reshape(mt_pad, g.mb, band)
-        t0 = start // g.mb  # first tile row/col with reflector data
-        rs = jnp.clip((t0 + g.pr - 1 - myr) // g.pr, 0, max(g.ltr - L, 0)).astype(
-            jnp.asarray(p).dtype
-        )
-        cs = jnp.clip((t0 + g.pc - 1 - myc) // g.pc, 0, max(g.ltc - C, 0)).astype(
-            jnp.asarray(p).dtype
-        )
-        gi_w = (rs + jnp.arange(L)) * g.pr + myr
-        gj_w = (cs + jnp.arange(C)) * g.pc + myc
-        vr = jnp.take(v_tiles, gi_w, axis=0)  # [L, mb, band] (gi_w < mt_pad)
-        valid_c = (gj_w < mt_pad)[:, None, None]
-        vc = jnp.where(
-            valid_c, jnp.take(v_tiles, jnp.clip(gj_w, 0, mt_pad - 1), axis=0), 0
-        )  # [C, mb, band]
-        with _scope("red2band.trailing_update"):
-            xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-            xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
-            xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
-            xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
-            mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
-            mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
-            w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
-            # mask W2 to the trailing region (element rows >= start)
-            ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
-            w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
-            w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
-            xs = (
-                xs
-                - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
-                - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
-            )
-            x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
-        # 4. write the factored panel strip back (element rows >= start on
-        # the owning tile column; start is generally NOT tile-aligned)
-        p_tiles = p_out.reshape(mt_pad, g.mb, band)
-        gi = _spmd.local_row_tiles(g, myr)
-        newcol_b = jnp.take(p_tiles, gi, axis=0)  # [ltr, mb, band]
-        ge_rows = gi[:, None] * g.mb + jnp.arange(g.mb)[None, :]
-        write = (ge_rows >= start)[:, :, None] & (myc == kc)
-        xc_now = _spmd.take_col(x, lkc, g)
-        cur_b = lax.dynamic_slice(xc_now, (0, 0, co), (g.ltr, g.mb, band))
-        new_b = jnp.where(write, newcol_b, cur_b)
-        xc_new = lax.dynamic_update_slice(xc_now, new_b, (0, 0, co))
-        x = _spmd.put_col(x, xc_new, lkc)
-        return x, taus_all
 
     carry = (x, taus_all)
     for p0, p1 in _spmd.halving_segments(n_panels):
         t0 = (p0 + 1) * band // g.mb
         L = max(min(g.ltr, (g.mt - 1 - t0 + g.pr - 1) // g.pr + 1), 1)
         C = max(min(g.ltc, (g.mt - 1 - t0 + g.pc - 1) // g.pc + 1), 1)
-        carry = lax.fori_loop(p0, p1, partial(body, L=L, C=C), carry)
+        body = partial(_red2band_step, g=g, band=band, myr=myr, myc=myc, L=L, C=C)
+        carry = lax.fori_loop(p0, p1, body, carry)
     x, taus_all = carry
     return coll.relocal(x), coll.relocal(taus_all)
 
 
+def _red2band_range_kernel(x, taus_all, p0, p1, g: _spmd.Geometry, band: int):
+    """Checkpoint-segment kernel: band panels ``p0 <= p < p1`` with traced
+    bounds, full L x C windows (L=ltr, C=ltc — V is zero outside the
+    trailing region, so the wide window is value-exact), taus carried
+    REPLICATED (every rank computes the panel QR redundantly from the
+    broadcast strip, so the stack is identical everywhere and round-trips
+    through checkpoints as a host array).  One compiled executable serves
+    every segment and every resumed continuation — resumed and
+    uninterrupted runs of the same cadence are bit-identical."""
+    x = coll.local(x)
+    myr, myc = coll.my_rank()
+    body = partial(
+        _red2band_step, g=g, band=band, myr=myr, myc=myc, L=g.ltr, C=g.ltc
+    )
+    # default-int bounds: the loop index feeds slice helpers that mix it
+    # with python-int literals (same cast as cholesky._chol_L_range_kernel)
+    idt = jnp.asarray(0).dtype
+    x, taus_all = lax.fori_loop(p0.astype(idt), p1.astype(idt), body, (x, taus_all))
+    return coll.relocal(x), taus_all
+
+
 _cache = {}
+_range_cache = {}
+
+
+def _compiled_range(grid, g: _spmd.Geometry, band: int, prec: str):
+    """Compiled checkpoint-segment executable:
+    ``(x, taus_all, p0, p1) -> (x, taus_all)`` with traced panel bounds and
+    a replicated taus carry.  Built on ``shard_map_compat`` directly — the
+    scalar bounds and the replicated taus stack need ``P()`` in_specs that
+    :func:`coll.spmd`'s uniform stacked specs cannot express."""
+    key = (grid.cache_key, g, band, prec, coll.collectives_trace_key())
+    if key not in _range_cache:
+        P = jax.sharding.PartitionSpec
+        spec = P(ROW_AXIS, COL_AXIS)
+        sm = coll.shard_map_compat(
+            partial(_red2band_range_kernel, g=g, band=band),
+            mesh=grid.mesh,
+            in_specs=(spec, P(), P(), P()),
+            out_specs=(spec, P()),
+        )
+        _range_cache[key] = jax.jit(sm, donate_argnums=(0,))
+    return _range_cache[key]
+
+
+def _reduce_checkpointed(full, g: _spmd.Geometry, band: int, n_panels: int,
+                         checkpoint_every: int, checkpoint_path, resume_from,
+                         prec: str):
+    """Segmented band reduction mirroring cholesky._factor_checkpointed:
+    ``checkpoint_every`` panels per range-kernel call, a
+    ``resilience.panel_boundary`` before each segment, a checkpoint
+    (matrix + taus stack + panel index + band) after each completed one.
+    ``full`` is the hermitized working copy and is repointed every segment.
+    Returns ``(data, taus_all)``."""
+    import numpy as np
+
+    from dlaf_tpu import resilience
+    from dlaf_tpu.health import DistributionError
+    from dlaf_tpu.tune import matmul_precision
+
+    kern = _compiled_range(full.grid, g, band, prec)
+    step = int(checkpoint_every) if checkpoint_every else n_panels
+    p = 0
+    taus = jnp.zeros((n_panels, band), full.dtype)
+    if resume_from is not None:
+        data, attrs, extras = resilience.load_checkpoint(
+            resume_from, full, algo="reduction_to_band", extras=("taus", "band")
+        )
+        if int(extras["band"]) != band:
+            raise DistributionError(
+                f"{resume_from}: checkpoint band {int(extras['band'])} != "
+                f"requested band {band}"
+            )
+        full._inplace(data)
+        p = int(attrs.get("panel", 0))
+        taus = jnp.asarray(extras["taus"].astype(np.dtype(full.dtype)))
+    while p < n_panels:
+        p1 = min(p + step, n_panels)
+        resilience.panel_boundary("reduction_to_band", p, full.data)
+        with matmul_precision(prec):
+            data, taus = kern(full.data, taus, np.int32(p), np.int32(p1))
+        full._inplace(data)
+        p = p1
+        if checkpoint_path is not None and p < n_panels:
+            resilience.save_checkpoint(
+                checkpoint_path, full, algo="reduction_to_band", panel=p,
+                extras={"taus": np.asarray(taus), "band": np.asarray(band)},
+            )
+    return full.data, taus
 
 
 def get_band_size(nb: int) -> int:
@@ -262,14 +358,26 @@ def get_band_size(nb: int) -> int:
 
 @origin_transparent
 def reduction_to_band(
-    mat_a: DistributedMatrix, band: int | None = None
+    mat_a: DistributedMatrix,
+    band: int | None = None,
+    checkpoint_every: int = 0,
+    checkpoint_path: str | None = None,
+    resume_from: str | None = None,
 ) -> Tuple[DistributedMatrix, jax.Array]:
     """Reduce Hermitian ``mat_a`` (``uplo='L'`` storage) to band form with
     band size ``band`` (default: tile size; must divide the tile size —
     reference get_band_size.h).  Returns (matrix holding band + reflector
     tails in the lower triangle, taus[n_panels, band]); the band size is
     recoverable as ``taus.shape[1]``.
-    """
+
+    Preemption safety (``dlaf_tpu.resilience``, same contract as
+    ``cholesky_factorization``): ``checkpoint_every=k`` segments the panel
+    loop and checkpoints matrix + taus stack + panel index to
+    ``checkpoint_path`` after each completed segment (collective atomic
+    rank-0 HDF5 write); ``resume_from=`` restores and re-enters at the
+    stored panel, bit-identical to an uninterrupted run of the same
+    cadence.  Segment boundaries enforce ambient ``resilience.deadline``
+    budgets and host fault injection."""
     if mat_a.size.rows != mat_a.size.cols or mat_a.block_size.rows != mat_a.block_size.cols:
         raise ValueError("reduction_to_band: square matrix with square tiles required")
     g = _spmd.Geometry.of(mat_a.dist)
@@ -284,6 +392,15 @@ def reduction_to_band(
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
+    ckpt = bool(checkpoint_every) or checkpoint_path is not None or resume_from is not None
+    if ckpt:
+        data, taus = _reduce_checkpointed(
+            full, g, band, n_panels, checkpoint_every, checkpoint_path,
+            resume_from, prec,
+        )
+        out = mat_a.like(data)
+        out.band_size = band
+        return out, taus
     key = (mat_a.grid.cache_key, g, band, prec, _spmd.bucket_ratio(),
            coll.collectives_trace_key())
     if key not in _cache:

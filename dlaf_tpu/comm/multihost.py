@@ -38,6 +38,7 @@ def initialize(
     retries: int = 0,
     backoff_s: float = 1.0,
     deadline_s: float | None = None,
+    initialization_timeout: float | None = None,
 ) -> None:
     """Bring up the JAX distributed runtime (idempotent).
 
@@ -57,6 +58,14 @@ def initialize(
     defaults (``retries=0``) keep behavior identical to before.
     Autodetected single-process no-ops never retry — there is nothing to
     wait for.
+
+    ``initialization_timeout`` bounds the coordinator HANDSHAKE itself (in
+    seconds, passed through to ``jax.distributed.initialize`` on jax
+    versions that support it) — without it only the inter-attempt backoff
+    honors ``deadline_s`` while each individual handshake blocks for jax's
+    default (5 minutes).  When unset but ``deadline_s`` is given, the
+    remaining deadline budget is used, so the whole bring-up — handshakes
+    included — stays inside ``deadline_s``.
     """
     global _initialized, _world_up
     explicit = coordinator_address is not None
@@ -81,14 +90,29 @@ def initialize(
     except (AttributeError, ValueError):  # older jax: gloo is implicit
         pass
 
+    import inspect
+
+    timeout_supported = (
+        "initialization_timeout"
+        in inspect.signature(jax.distributed.initialize).parameters
+    )
     start = time.monotonic()
     attempt = 0
     while True:
+        init_kwargs = {}
+        if timeout_supported:
+            timeout = initialization_timeout
+            if timeout is None and deadline_s is not None:
+                # bound each handshake by what is left of the deadline
+                timeout = max(deadline_s - (time.monotonic() - start), 1.0)
+            if timeout is not None:
+                init_kwargs["initialization_timeout"] = int(max(timeout, 1.0))
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
+                **init_kwargs,
             )
             _world_up = True
             break

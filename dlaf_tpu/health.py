@@ -88,6 +88,45 @@ class NonFiniteError(DlafError, ArithmeticError):
         )
 
 
+class DeadlineExceededError(DlafError, TimeoutError):
+    """A deadline-bounded operation did not complete within its budget
+    (``resilience.deadline`` / ``run_with_deadline``).  ``budget_s`` is the
+    wall-clock bound that was exceeded; ``label`` names the bounded
+    operation when the caller supplied one.  Subclasses ``TimeoutError``
+    so generic timeout handlers keep working."""
+
+    def __init__(self, budget_s: float, label: str | None = None,
+                 message: str | None = None):
+        self.budget_s = float(budget_s)
+        self.label = label
+        if message is None:
+            what = f" ({label})" if label else ""
+            message = (
+                f"operation{what} exceeded its deadline of "
+                f"{self.budget_s:g} s"
+            )
+        super().__init__(message)
+
+
+class DeviceUnresponsiveError(DlafError, RuntimeError):
+    """The device watchdog's bounded liveness probe was exhausted: the
+    device did not answer a tiny pre-compiled kernel within ``budget_s``
+    (a hung TPU tunnel, a preempted host, a wedged runtime — the failure
+    mode behind bench rounds reporting 0.0 GFlop/s)."""
+
+    def __init__(self, budget_s: float = 0.0, device: str = "default",
+                 message: str | None = None):
+        self.budget_s = float(budget_s)
+        self.device = device
+        super().__init__(
+            message
+            or (
+                f"device {device} unresponsive: liveness probe did not "
+                f"complete within {self.budget_s:g} s"
+            )
+        )
+
+
 # ----------------------------------------------------------- event stream
 
 _captured: list | None = None
@@ -130,21 +169,25 @@ def check_finite(stage: str, *operands) -> None:
     sentinels off (the same guarantee obs.comms makes for accounting).
 
     At level >= 2 every operand (``DistributedMatrix`` or array) is
-    reduced with ``isfinite`` — a host sync, like every heavy check — and
-    the first non-finite operand raises :class:`NonFiniteError` naming
-    ``stage``.  Collective-safe: on multi-process grids all processes
-    must call this (all do — it sits in SPMD driver code every rank runs).
+    reduced with ``isfinite``; the per-operand flags are stacked into ONE
+    device→host sync per call site (not one per operand), and the first
+    non-finite operand raises :class:`NonFiniteError` naming ``stage``.
+    Collective-safe: on multi-process grids all processes must call this
+    (all do — it sits in SPMD driver code every rank runs).
     """
     from dlaf_tpu.common import checks
 
     if checks.check_level() < 2:
         return
     import jax.numpy as jnp
+    import numpy as np
 
-    for op in operands:
-        if op is None:
-            continue
-        data = getattr(op, "data", op)
-        if not bool(jnp.all(jnp.isfinite(data))):
-            record("nonfinite", stage=stage)
-            raise NonFiniteError(stage)
+    datas = [getattr(op, "data", op) for op in operands if op is not None]
+    if not datas:
+        return
+    flags = np.asarray(
+        jnp.stack([jnp.all(jnp.isfinite(d)) for d in datas])
+    )
+    if not flags.all():
+        record("nonfinite", stage=stage, operand=int(np.argmin(flags)))
+        raise NonFiniteError(stage)

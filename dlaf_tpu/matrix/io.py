@@ -101,12 +101,17 @@ def _row_fetch_fn(grid: Grid, shape, dtype):
     return _row_fetch_cache[key]
 
 
-def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
+def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a",
+              attrs: dict | None = None, datasets: dict | None = None) -> None:
     """Write to an HDF5 dataset ``name`` of global shape (reference
     FileHDF5::write, matrix/hdf5.h:94-308).  Streams one tile-row slab at a
     time — a single device fetch of that row's tile stack per slab, <= mb x N
     host staging, never the full N^2; block/grid geometry is attached as
     dataset attributes so a read can reproduce the distribution.
+    ``attrs`` adds caller attributes to the dataset and ``datasets`` adds
+    sibling datasets from host arrays (``resilience.save_checkpoint`` rides
+    these for its panel index / taus stack), all in the same single rank-0
+    write pass.
 
     COLLECTIVE on multi-process worlds: every process must call it (the
     per-slab gathers are collectives); only process 0 touches the file, and
@@ -128,6 +133,10 @@ def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a") -> None:
             ds.attrs["block_size"] = tuple(mat.block_size)
             ds.attrs["grid_size"] = tuple(mat.dist.grid_size)
             ds.attrs["source_rank"] = (sr, sc)
+            for k, v in (attrs or {}).items():
+                ds.attrs[k] = v
+            for dname, arr in (datasets or {}).items():
+                f.create_dataset(dname, data=np.asarray(arr))
         for i in range(mat.nr_tiles.rows):
             r0 = i * mb
             rows = min(mb, m - r0)

@@ -19,7 +19,30 @@ def flops(args):
 
 
 def main(argv=None):
-    args = common.miniapp_parser(__doc__).parse_args(argv)
+    parser = common.miniapp_parser(__doc__)
+    parser.add_argument(
+        "--checkpoint-every", type=int, default=0, metavar="K",
+        help="checkpoint the factorization every K panels "
+        "(dlaf_tpu.resilience; requires --checkpoint-path)",
+    )
+    parser.add_argument(
+        "--checkpoint-path", default="", metavar="FILE",
+        help="HDF5 checkpoint file for --checkpoint-every (atomic rank-0 "
+        "write after each completed segment)",
+    )
+    parser.add_argument(
+        "--resume-from", default="", metavar="FILE",
+        help="resume the factorization from a checkpoint written by a "
+        "preempted --checkpoint-every run (bit-exact with an uninterrupted "
+        "run of the same cadence)",
+    )
+    parser.add_argument(
+        "--deadline", type=float, default=0.0, metavar="S",
+        help="ambient resilience.deadline for each run: panel-boundary "
+        "syncs are bounded and DeadlineExceededError replaces an "
+        "unbounded block",
+    )
+    args = parser.parse_args(argv)
     grid = common.make_grid(args)
     dtype = common.DTYPES[args.type]
     a = common.host_input(args, dtype, lambda: tu.random_hermitian_pd(args.m, dtype, seed=1))
@@ -30,7 +53,20 @@ def main(argv=None):
         return DistributedMatrix.from_global(grid, common.tri(uplo)(a), (args.mb, args.mb))
 
     def run(mat):
-        return cholesky_factorization(uplo, mat)
+        from contextlib import nullcontext
+
+        from dlaf_tpu import resilience
+
+        bound = resilience.deadline(args.deadline, label="miniapp_cholesky") \
+            if args.deadline > 0 else nullcontext()
+        with bound:
+            return cholesky_factorization(
+                uplo,
+                mat,
+                checkpoint_every=args.checkpoint_every,
+                checkpoint_path=args.checkpoint_path or None,
+                resume_from=args.resume_from or None,
+            )
 
     def check(out):
         l = np.linalg.cholesky(a)

@@ -207,6 +207,26 @@ def emit_comms(acc: dict) -> None:
     emit("comms", rows=comms.as_records(acc))
 
 
+def append_records(path: str, records: list, rank: int = 0) -> None:
+    """Append schema-stamped records to ``path`` WITHOUT importing jax.
+
+    For host-side supervisors that must write metrics about a device that
+    may be dead (bench.py's parent process classifying an unresponsive
+    child): creating an emitter would bring up the very backend being
+    diagnosed.  Each record supplies ``kind`` plus its payload fields;
+    ``schema``/``ts``/``rank`` are stamped here and every record is
+    validated before anything is written (all-or-nothing)."""
+    stamped = []
+    for rec in records:
+        out = {"schema": SCHEMA, "ts": time.time(), "rank": int(rank)}
+        out.update(rec)
+        validate_record(out)
+        stamped.append(out)
+    with open(path, "a") as fh:
+        for out in stamped:
+            fh.write(json.dumps(out, default=_jsonable) + "\n")
+
+
 def validate_record(rec: dict) -> None:
     """Raise ValueError unless ``rec`` is a schema-valid metrics record."""
     if not isinstance(rec, dict):

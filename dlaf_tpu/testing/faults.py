@@ -6,15 +6,31 @@ module is that constructor kit for dlaf_tpu: every helper builds an input
 whose failure mode — and failure LOCATION — is known exactly, so tests can
 assert the detectors report the right thing, not merely that they fire.
 
-All helpers are host-side numpy: faults are injected into the operand
+All data helpers are host-side numpy: faults are injected into the operand
 BEFORE it enters a driver, never by patching driver internals, so the
 detection path under test is exactly the production path.
+
+TIMING faults (:func:`hang`, :func:`slow_collective`, :func:`preempt_at`)
+cannot ride an operand — they are injected through the documented
+``dlaf_tpu.resilience`` injection points instead (the bounded device-wait
+path and the driver panel boundaries), which the production detectors
+(deadlines, watchdog, checkpoint restore) always traverse.  Each is a
+context manager restoring the previous injection state on exit.
 """
 from __future__ import annotations
+
+from contextlib import contextmanager
 
 import numpy as np
 
 from dlaf_tpu.testing import random_hermitian_pd, random_matrix
+
+
+class PreemptedError(RuntimeError):
+    """The simulated-preemption fault: raised out of a driver's panel
+    boundary by :func:`preempt_at`, standing in for the SIGKILL a real
+    preemption delivers (same observable effect on the driver: the panel
+    loop dies between segments, the last checkpoint survives)."""
 
 
 def break_spd(a: np.ndarray, pivot: int, magnitude: float = 10.0) -> np.ndarray:
@@ -57,6 +73,62 @@ def nan_tile(
         raise ValueError(f"tile ({i}, {j}) outside {a.shape} at block {block}")
     out[rs : rs + block, cs : cs + block] = value
     return out
+
+
+@contextmanager
+def hang(seconds: float):
+    """Inject a device stall: every bounded device wait (the resilience
+    ``sync`` path, watchdog probes, checkpointed drivers' panel-boundary
+    syncs under an ambient deadline) blocks ``seconds`` extra before
+    completing — an unresponsive device as the deadline/watchdog detectors
+    see one.  A wait whose budget is below ``seconds`` times out and
+    raises ``DeadlineExceededError`` through the production path."""
+    from dlaf_tpu import resilience
+
+    prev = resilience._injected["sync_delay"]
+    resilience._injected["sync_delay"] = float(seconds)
+    try:
+        yield
+    finally:
+        resilience._injected["sync_delay"] = prev
+
+
+@contextmanager
+def slow_collective(seconds: float):
+    """Inject interconnect slowness: each driver panel boundary stalls
+    ``seconds`` before its deadline check — a slow collective as ambient
+    ``resilience.deadline`` budgets experience one (the budget drains
+    across panels until ``DeadlineExceededError``)."""
+    from dlaf_tpu import resilience
+
+    prev = resilience._injected["panel_delay"]
+    resilience._injected["panel_delay"] = float(seconds)
+    try:
+        yield
+    finally:
+        resilience._injected["panel_delay"] = prev
+
+
+@contextmanager
+def preempt_at(panel: int, algo: str | None = None):
+    """Simulate preemption: kill the driver (raise :class:`PreemptedError`)
+    at the FIRST panel boundary with ``panel_index >= panel`` (of ``algo``
+    when given, any checkpointed driver otherwise).  Panels below ``panel``
+    complete and checkpoint normally, so a subsequent ``resume_from=`` run
+    exercises the real restore path."""
+    from dlaf_tpu import resilience
+
+    def hook(a: str, p: int):
+        if (algo is None or a == algo) and p >= panel:
+            raise PreemptedError(
+                f"simulated preemption: {a} killed at panel {p} (>= {panel})"
+            )
+
+    resilience._injected["boundary_hooks"].append(hook)
+    try:
+        yield
+    finally:
+        resilience._injected["boundary_hooks"].remove(hook)
 
 
 def ill_conditioned_pd(n: int, dtype, cond: float = 1e12, seed: int = 0) -> np.ndarray:

@@ -158,6 +158,28 @@ def summarize(path: str) -> int:
         print(f"-- health events ({len(health)}):")
         for e, n in sorted(counts.items()):
             print(f"   {n:6d}  {e}")
+        # resilience roll-up: the bounded-time/restart story in four lines
+        # (deadlines that fired, probe outcomes, checkpoint traffic,
+        # degraded-mode dispatches) — see dlaf_tpu/resilience.py EVENTS
+        res = {e: n for e, n in counts.items()
+               if e in ("deadline_exceeded", "deadline_expired", "device_probe",
+                        "device_unresponsive", "fallback_dispatch",
+                        "checkpoint_written", "checkpoint_restored",
+                        "checkpoint_config_mismatch")}
+        if res:
+            print("-- resilience:")
+            dl = res.get("deadline_exceeded", 0) + res.get("deadline_expired", 0)
+            print(f"   deadlines hit: {dl} "
+                  f"(exceeded {res.get('deadline_exceeded', 0)}, "
+                  f"monitor-expired {res.get('deadline_expired', 0)})")
+            print(f"   watchdog probes: {res.get('device_probe', 0)} ok, "
+                  f"{res.get('device_unresponsive', 0)} unresponsive")
+            print(f"   checkpoints: {res.get('checkpoint_written', 0)} written, "
+                  f"{res.get('checkpoint_restored', 0)} restored"
+                  + (f", {res['checkpoint_config_mismatch']} config drifts"
+                     if res.get("checkpoint_config_mismatch") else ""))
+            if res.get("fallback_dispatch"):
+                print(f"   degraded-mode fallbacks: {res['fallback_dispatch']}")
         for r in health:
             detail = "  ".join(
                 f"{k}={r[k]}"

@@ -239,10 +239,50 @@ def case_hdf5(grid, args):
         os.remove(path)
 
 
+def case_potrf_ckpt(grid, args):
+    """Preemption-safe checkpoint/restart across REAL processes: every rank
+    simulates preemption at the same panel (the hook fires rank-locally but
+    deterministically), then the resumed factorization — whose checkpoint
+    was written by the COLLECTIVE save_hdf5 path and re-read by every rank —
+    must be bit-identical to an uninterrupted run of the same cadence."""
+    import os
+    import tempfile
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+    from dlaf_tpu.comm import multihost
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+    from dlaf_tpu.testing import faults
+
+    a = tu.random_hermitian_pd(args.n, np.float32, seed=44)
+    mk = lambda: DistributedMatrix.from_global(grid, np.tril(a), (args.nb, args.nb))
+    ref = cholesky_factorization("L", mk(), checkpoint_every=2).to_global()
+    path = os.path.join(tempfile.gettempdir(), f"dlaf_mp_ckpt_{args.nprocs}.h5")
+    try:
+        with faults.preempt_at(2, algo="cholesky"):
+            cholesky_factorization(
+                "L", mk(), checkpoint_every=2, checkpoint_path=path
+            )
+        raise AssertionError("preempt_at(2) did not fire")
+    except faults.PreemptedError:
+        pass
+    out = cholesky_factorization(
+        "L", mk(), checkpoint_every=2, checkpoint_path=path, resume_from=path
+    )
+    np.testing.assert_array_equal(ref, out.to_global())
+    multihost_utils.sync_global_devices("multiproc_worker.case_potrf_ckpt")
+    if multihost.process_info()[0] == 0:
+        os.remove(path)
+
+
 CASES = {
     "roundtrip": case_roundtrip,
     "hdf5": case_hdf5,
     "potrf": case_potrf,
+    "potrf_ckpt": case_potrf_ckpt,
     "potrf_src": case_potrf_src,
     "heev": case_heev,
     "hegv": case_hegv,

@@ -214,7 +214,7 @@ def test_check_finite_raises_at_level_2(grid_2x4):
             with pytest.raises(dlaf_tpu.NonFiniteError) as ei:
                 health.check_finite("unit", _mat(grid_2x4, a))
         assert ei.value.stage == "unit"
-        assert events == [{"event": "nonfinite", "stage": "unit"}]
+        assert events == [{"event": "nonfinite", "stage": "unit", "operand": 0}]
         health.check_finite("unit", _mat(grid_2x4, np.nan_to_num(a)), None)  # clean + None ok
     finally:
         checks.set_check_level(None)
