@@ -325,6 +325,17 @@ class _Child:
             if self.t_left() < 30:
                 self._note(f"stopping before n>{n}: {self.t_left():.0f}s left")
                 break
+        # batched serving throughput (ISSUE 5): one vmapped B=16 N=512
+        # posv dispatch vs a Python loop of 16 single solver calls on the
+        # same devices — the number behind the serve acceptance criterion
+        if self.t_left() > 120:
+            try:
+                self.rec["serve"] = self._time_batched_posv(16, 512)
+                self._flush()
+            except BaseException as e:  # noqa: BLE001
+                self._note(f"serve batched posv failed: {type(e).__name__}: {e}")
+        else:
+            self._note(f"serve batched posv skipped: {self.t_left():.0f}s left")
         # LAST (flips x64; nothing f32 runs after): the mixed-precision A/B —
         # f32-factor-plus-refinement posv vs emulated-f64 posv, the
         # on-hardware number behind the round-4 mixed-precision claim
@@ -342,6 +353,71 @@ class _Child:
             om.emit("bench", record=self.rec)
             om.close()
         return 0
+
+    def _time_batched_posv(self, bsz, n):
+        """Batched-serving throughput: best-of-2 timed B=``bsz`` N=``n``
+        f32 batched posv dispatches (after a warmup/compile run) and —
+        budget allowing — the same problems as a loop of single
+        positive_definite_solver calls for the speedup column."""
+        import jax
+
+        import dlaf_tpu.testing as tu
+        from dlaf_tpu import serve
+        from dlaf_tpu.algorithms.solver import positive_definite_solver
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.common.index import Size2D
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+        a = np.stack(
+            [tu.random_hermitian_pd(n, np.float32, seed=50 + i) for i in range(bsz)]
+        )
+        rhs = np.stack(
+            [tu.random_matrix(n, 1, np.float32, seed=80 + i) for i in range(bsz)]
+        )
+        cache = serve.CompiledCache()
+        times = []
+        for i in range(NRUNS + 1):
+            t0 = time.perf_counter()
+            _, info = serve.batched_positive_definite_solver(
+                "L", a, rhs, cache=cache
+            )
+            dt = time.perf_counter() - t0
+            assert np.all(np.asarray(info) == 0), info
+            if i > 0:
+                times.append(dt)
+        best = min(times)
+        # in a fused batch every member's latency IS the dispatch time
+        p50 = sorted(times)[len(times) // 2]
+        rec = {
+            "metric": f"batched_posv_throughput_b{bsz}_n{n}_f32",
+            "seconds": round(best, 4),
+            "problems_per_s": round(bsz / best, 2),
+            "p50_latency_s": round(p50, 4),
+            "batch": bsz,
+            "n": n,
+        }
+        if self.t_left() > 60:
+            # baseline: the same problems through the single-call driver
+            grid = Grid.create(Size2D(1, jax.device_count()))
+            mb = min(128, n)
+
+            def loop():
+                for i in range(bsz):
+                    mat_a = DistributedMatrix.from_global(
+                        grid, np.tril(a[i]), (mb, mb)
+                    )
+                    mat_b = DistributedMatrix.from_global(grid, rhs[i], (mb, mb))
+                    np.asarray(
+                        positive_definite_solver("L", mat_a, mat_b).to_global()
+                    )
+
+            loop()  # warmup/compile
+            t0 = time.perf_counter()
+            loop()
+            loop_s = time.perf_counter() - t0
+            rec["single_loop_seconds"] = round(loop_s, 4)
+            rec["speedup_vs_single_loop"] = round(loop_s / best, 2)
+        return rec
 
     def _time_posv_mixed(self, n):
         """One timed mixed solve and one timed full-f64 solve at N=n,

@@ -19,6 +19,7 @@ from dlaf_tpu.health import (
     DlafError,
     NonFiniteError,
     NotPositiveDefiniteError,
+    QueueFullError,
 )
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
@@ -76,6 +77,7 @@ __all__ = [
     "NonFiniteError",
     "DeadlineExceededError",
     "DeviceUnresponsiveError",
+    "QueueFullError",
     "Distribution",
     "DistributedMatrix",
     "MatrixRef",

@@ -74,6 +74,18 @@ def trsm_trace_key() -> bool:
     return bool(get_tune_parameters().panel_trsm_pallas)
 
 
+def serve_trace_key():
+    """The active serve-bucket token (None outside ``dlaf_tpu.serve``) —
+    same discipline as :func:`trsm_trace_key`: compilations triggered on
+    behalf of a serve bucket carry the bucket identity in the kernel
+    compile-cache keys, so an evicted-and-rebuilt bucket can never alias a
+    kernel traced for a different one.  Lazy import: serve is an optional
+    L7 layer and the kernels must not pull it in at import time."""
+    from dlaf_tpu.serve.context import serve_trace_key as _key
+
+    return _key()
+
+
 def halving_segments(n: int, ratio: float | None = None):
     """Panel-index segments [k0, k1) whose trailing extent shrinks by
     ``ratio`` per segment, so each segment runs with one static

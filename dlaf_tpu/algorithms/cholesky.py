@@ -337,7 +337,7 @@ def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
     # only the bucketed variant bakes ratio-dependent segments
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
     key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(),
-           coll.collectives_trace_key(), want_info)
+           coll.collectives_trace_key(), _spmd.serve_trace_key(), want_info)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,
@@ -370,7 +370,8 @@ def _compiled_range(grid, g: _spmd.Geometry):
     one executable serves every segment and every resumed continuation.
     Built directly on ``shard_map_compat`` (not :func:`coll.spmd`, whose
     uniform ``P('r','c')`` in_specs would shard the scalar bounds)."""
-    key = (grid.cache_key, g, _spmd.trsm_trace_key(), coll.collectives_trace_key())
+    key = (grid.cache_key, g, _spmd.trsm_trace_key(), coll.collectives_trace_key(),
+           _spmd.serve_trace_key())
     if key not in _range_cache:
         P = jax.sharding.PartitionSpec
         spec = P(ROW_AXIS, COL_AXIS)
@@ -437,7 +438,8 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, np.dtype(mat_a.dtype), uplo, _spmd.trsm_trace_key())
+    key = (dist, np.dtype(mat_a.dtype), uplo, _spmd.trsm_trace_key(),
+           _spmd.serve_trace_key())
     if key not in _local_cache:
 
         @jax.jit

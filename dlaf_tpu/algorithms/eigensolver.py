@@ -251,7 +251,9 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
         )
     # two jits: the expensive eigh compiles once per (dist, dtype); each
     # spectrum slice only adds a tiny slice-and-pack executable
-    key = (dist, np.dtype(mat_a.dtype))
+    from dlaf_tpu.algorithms import _spmd
+
+    key = (dist, np.dtype(mat_a.dtype), _spmd.serve_trace_key())
     if key not in _eigh_cache:
 
         @jax.jit
@@ -261,7 +263,7 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
             return jnp.linalg.eigh(full)  # dense (w, v), on device
 
         _eigh_cache[key] = run
-    pkey = ("pack", dist, np.dtype(mat_a.dtype), sl)
+    pkey = ("pack", dist, np.dtype(mat_a.dtype), sl, _spmd.serve_trace_key())
     if pkey not in _eigh_cache:
 
         @jax.jit

@@ -37,6 +37,41 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.ops import tile as t
 
 
+def _check_solve_geometry(what: str, uplo: str, mat_a: DistributedMatrix,
+                          mat_b: DistributedMatrix) -> None:
+    """Up-front B-geometry validation for the POTRS/POSV compositions.
+
+    Multi-RHS ``(N, k)`` stacks are first-class — only the ROW geometry of
+    B must match A.  Without this gate a mismatched B surfaces as a raw
+    XLA shape error deep inside the trsm kernel; here it is a
+    :class:`~dlaf_tpu.health.DistributionError` naming the mismatch."""
+    from dlaf_tpu.health import DistributionError
+
+    if uplo not in (t.LOWER, t.UPPER):
+        raise DistributionError(f"{what}: uplo must be 'L' or 'U', got {uplo!r}")
+    if mat_a.size.rows != mat_a.size.cols:
+        raise DistributionError(f"{what}: A must be square, got {mat_a.size}")
+    if mat_a.block_size.rows != mat_a.block_size.cols:
+        raise DistributionError(
+            f"{what}: A tiles must be square, got {mat_a.block_size}"
+        )
+    if mat_b.size.rows != mat_a.size.rows:
+        raise DistributionError(
+            f"{what}: b must have N = {mat_a.size.rows} rows to match A "
+            f"{mat_a.size} (multi-RHS (N, k) stacks welcome), got b {mat_b.size}"
+        )
+    if mat_b.block_size.rows != mat_a.block_size.rows:
+        raise DistributionError(
+            f"{what}: b row tiling {mat_b.block_size} must match A's "
+            f"{mat_a.block_size} (same block rows)"
+        )
+    if mat_a.grid is not mat_b.grid and mat_a.grid.grid_size != mat_b.grid.grid_size:
+        raise DistributionError(
+            f"{what}: A and b must share the process grid; got "
+            f"{mat_a.grid.grid_size} vs {mat_b.grid.grid_size}"
+        )
+
+
 @origin_transparent
 def cholesky_solver(
     uplo: str, mat_l: DistributedMatrix, mat_b: DistributedMatrix
@@ -44,6 +79,7 @@ def cholesky_solver(
     """POTRS: solve A X = B given the Cholesky factor of A in the ``uplo``
     triangle of ``mat_l`` (as produced by ``cholesky_factorization``).
     Returns the updated B (functional in-place, like the trsm it wraps)."""
+    _check_solve_geometry("cholesky_solver", uplo, mat_l, mat_b)
     if uplo == t.LOWER:
         y = triangular_solver(t.LEFT, t.LOWER, t.NO_TRANS, t.NON_UNIT, 1.0, mat_l, mat_b)
         return triangular_solver(t.LEFT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, mat_l, y)
@@ -69,6 +105,7 @@ def positive_definite_solver(
     ``raise_on_failure=True`` raises
     :class:`~dlaf_tpu.health.NotPositiveDefiniteError` instead of letting
     NaNs flow into the triangular solves."""
+    _check_solve_geometry("positive_definite_solver", uplo, mat_a, mat_b)
     if return_info or raise_on_failure:
         fac, info = cholesky_factorization(
             uplo, mat_a, return_info=True, raise_on_failure=raise_on_failure

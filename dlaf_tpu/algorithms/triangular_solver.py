@@ -363,7 +363,7 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
 
     da, db = mat_a.dist, mat_b.dist
     key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha),
-           _spmd.trsm_trace_key())
+           _spmd.trsm_trace_key(), _spmd.serve_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -429,7 +429,7 @@ def triangular_solver(
         else None
     )
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), _spmd.trsm_trace_key(), g_a, g_b,
-           lookahead, ratio, coll.collectives_trace_key())
+           lookahead, ratio, coll.collectives_trace_key(), _spmd.serve_trace_key())
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))

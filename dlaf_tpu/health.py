@@ -108,6 +108,24 @@ class DeadlineExceededError(DlafError, TimeoutError):
         super().__init__(message)
 
 
+class QueueFullError(DlafError, RuntimeError):
+    """A ``serve.SolverPool`` rejected a submission under backpressure:
+    the queue already holds ``size`` requests against a bound of
+    ``capacity`` (``tune.serve_max_queue``).  Callers should shed load or
+    retry after draining results — the pool never blocks ``submit``."""
+
+    def __init__(self, size: int, capacity: int, message: str | None = None):
+        self.size = int(size)
+        self.capacity = int(capacity)
+        super().__init__(
+            message
+            or (
+                f"solver pool queue is full: {self.size} queued requests "
+                f"at capacity {self.capacity}"
+            )
+        )
+
+
 class DeviceUnresponsiveError(DlafError, RuntimeError):
     """The device watchdog's bounded liveness probe was exhausted: the
     device did not answer a tiny pre-compiled kernel within ``budget_s``

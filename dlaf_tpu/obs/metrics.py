@@ -40,6 +40,7 @@ REQUIRED_FIELDS: dict = {
     "compile_cache": ("event",),
     "note": ("text",),
     "health": ("event",),
+    "serve": ("event",),
 }
 
 _emitter = None

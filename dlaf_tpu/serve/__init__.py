@@ -1,0 +1,44 @@
+"""dlaf_tpu.serve — batched solver service (L7 over the whole stack).
+
+Three pieces (see each module's docstring):
+
+* :mod:`~dlaf_tpu.serve.batched` — ``batched_cholesky_factorization`` /
+  ``batched_positive_definite_solver`` / ``batched_eigensolver``: vmapped
+  SPMD kernels over a leading batch axis, per-element info codes, optional
+  batch-axis sharding for small-N traffic.
+* :mod:`~dlaf_tpu.serve.bucketing` — shape buckets
+  (``tune.serve_buckets``) and the bounded LRU
+  :class:`~dlaf_tpu.serve.bucketing.CompiledCache` of executables with
+  hit/miss/evict counters through ``obs.metrics``.
+* :mod:`~dlaf_tpu.serve.pool` — :class:`~dlaf_tpu.serve.pool.SolverPool`
+  futures front door: queueing, request fusion, deadlines
+  (``resilience``), :class:`~dlaf_tpu.health.QueueFullError`
+  backpressure.
+"""
+from dlaf_tpu.serve.batched import (
+    batched_cholesky_factorization,
+    batched_eigensolver,
+    batched_positive_definite_solver,
+)
+from dlaf_tpu.serve.bucketing import (
+    CompiledCache,
+    bucket_for,
+    bucket_table,
+    default_cache,
+)
+from dlaf_tpu.serve.context import serve_trace_key, serving
+from dlaf_tpu.serve.pool import ServeResult, SolverPool
+
+__all__ = [
+    "CompiledCache",
+    "ServeResult",
+    "SolverPool",
+    "batched_cholesky_factorization",
+    "batched_eigensolver",
+    "batched_positive_definite_solver",
+    "bucket_for",
+    "bucket_table",
+    "default_cache",
+    "serve_trace_key",
+    "serving",
+]

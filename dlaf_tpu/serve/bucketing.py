@@ -1,0 +1,132 @@
+"""Shape bucketing and the bounded executable cache for the serve layer.
+
+Every distinct problem shape would otherwise compile its own executable —
+on TPU the compile costs seconds while the solve costs milliseconds, so a
+service must pad requests up to a small set of geometry buckets and reuse
+one executable per bucket (the tritonBLAS approach, arXiv:2512.04226: pick
+the compiled variant analytically from shape, never recompile per
+request).  Two pieces:
+
+* :func:`bucket_for` — the bucket table from ``tune.serve_buckets``
+  (env ``DLAF_TPU_SERVE_BUCKETS``, comma-separated Ns).  A request of size
+  ``n`` is padded up to the smallest bucket >= n; sizes beyond the largest
+  bucket round up to a multiple of it (open-ended tail, still a bounded
+  number of shapes per decade).
+
+* :class:`CompiledCache` — a bounded LRU of compiled executables keyed on
+  the full bucket identity (kind, N, dtype, uplo, mode, and every
+  trace-time knob).  Hits/misses/evictions are counted locally (tests
+  assert on ``counters``) and emitted through ``obs.metrics`` as ``serve``
+  events; builds run under :func:`~dlaf_tpu.serve.context.serving` so any
+  kernel-module cache entries created on the way carry the bucket token in
+  their keys.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve.context import serving
+
+
+def bucket_table() -> tuple:
+    """The configured bucket sizes, ascending (``tune.serve_buckets``)."""
+    from dlaf_tpu.health import DistributionError
+    from dlaf_tpu.tune import get_tune_parameters
+
+    raw = str(get_tune_parameters().serve_buckets)
+    try:
+        table = sorted({int(p) for p in raw.split(",") if p.strip()})
+    except ValueError as e:
+        raise DistributionError(f"serve_buckets must be comma-separated ints, got {raw!r}") from e
+    if not table or table[0] <= 0:
+        raise DistributionError(f"serve_buckets must be positive, got {raw!r}")
+    return tuple(table)
+
+
+def bucket_for(n: int) -> int:
+    """Bucket size a problem of order ``n`` is padded up to."""
+    from dlaf_tpu.health import DistributionError
+
+    n = int(n)
+    if n <= 0:
+        raise DistributionError(f"serve: problem size must be positive, got {n}")
+    table = bucket_table()
+    for b in table:
+        if n <= b:
+            return b
+    top = table[-1]
+    return ((n + top - 1) // top) * top
+
+
+def bucket_label(key) -> str:
+    """Human/metrics label for a bucket key (kind/N/dtype/... joined)."""
+    return "/".join(str(p) for p in key) if isinstance(key, tuple) else str(key)
+
+
+class CompiledCache:
+    """Bounded LRU of compiled executables, eviction-counted.
+
+    ``get(key, builder)`` returns the cached executable for ``key`` or
+    builds it (under ``serving(key)``), evicting the least-recently-used
+    entries beyond ``capacity`` (default ``tune.serve_cache_capacity``).
+    ``counters`` holds cumulative ``hit``/``miss``/``evict`` counts; the
+    same events go to ``obs.metrics`` (kind ``serve``) when enabled.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            from dlaf_tpu.tune import get_tune_parameters
+
+            capacity = int(get_tune_parameters().serve_cache_capacity)
+        if capacity < 1:
+            from dlaf_tpu.health import DistributionError
+
+            raise DistributionError(f"serve cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._entries: OrderedDict = OrderedDict()
+        self.counters = {"hit": 0, "miss": 0, "evict": 0}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    def hit_rate(self) -> float:
+        tot = self.counters["hit"] + self.counters["miss"]
+        return self.counters["hit"] / tot if tot else 0.0
+
+    def get(self, key, builder):
+        if key in self._entries:
+            self.counters["hit"] += 1
+            self._entries.move_to_end(key)
+            om.emit("serve", event="cache_hit", bucket=bucket_label(key))
+            return self._entries[key]
+        self.counters["miss"] += 1
+        om.emit("serve", event="cache_miss", bucket=bucket_label(key))
+        t0 = time.perf_counter()
+        with serving(key):
+            fn = builder()
+        om.emit(
+            "serve", event="compile", bucket=bucket_label(key),
+            seconds=time.perf_counter() - t0,
+        )
+        self._entries[key] = fn
+        while len(self._entries) > self.capacity:
+            old, _ = self._entries.popitem(last=False)
+            self.counters["evict"] += 1
+            om.emit("serve", event="cache_evict", bucket=bucket_label(old))
+        return fn
+
+
+_default_cache: CompiledCache | None = None
+
+
+def default_cache() -> CompiledCache:
+    """The process-wide serve cache (capacity from tune at first use)."""
+    global _default_cache
+    if _default_cache is None:
+        _default_cache = CompiledCache()
+    return _default_cache

@@ -1,0 +1,42 @@
+"""Serve trace context: tag kernel compilations triggered by the service.
+
+The serve layer keeps its own BOUNDED executable cache (bucketing.py) while
+the kernel modules keep unbounded per-process caches keyed on everything
+that changes the trace (geometry, tune knobs, collective tier).  When a
+compilation happens on behalf of a serve bucket, the active bucket token is
+folded into those kernel cache keys too — same discipline as
+``_spmd.trsm_trace_key`` / ``coll.collectives_trace_key``: a knob outside
+the key is a dead knob.  Here the "knob" is the serving context itself, so
+an evicted-and-rebuilt bucket can never silently alias a kernel traced for
+a different bucket, and the serve LRU stays the single authority for which
+bucket executables are live.
+
+This module is a LEAF (no dlaf_tpu imports): the kernel modules read the
+token through a lazy import at key-construction time, so no import cycles.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+_active: object = None
+
+
+@contextmanager
+def serving(token):
+    """Mark compilations inside the context as owned by serve bucket
+    ``token`` (any hashable; ``bucketing.CompiledCache`` passes the bucket
+    key).  Nestable; restores the previous token on exit."""
+    global _active
+    prev = _active
+    _active = token
+    try:
+        yield
+    finally:
+        _active = prev
+
+
+def serve_trace_key():
+    """The active serve bucket token (None outside the service) — folded
+    into every compiled-kernel cache key alongside the other trace-time
+    knobs."""
+    return _active

@@ -1,0 +1,306 @@
+"""Async submission pool: futures in, batched bucket dispatches out.
+
+``SolverPool`` is the service front door.  Callers ``submit()`` single
+problems and get ``concurrent.futures.Future`` objects back immediately;
+a daemon worker drains the queue, groups compatible requests (same kind /
+uplo / bucket / dtype / RHS width), pads each to the common bucket order
+and dispatches ONE batched driver call per group — so a burst of N small
+requests costs one executable launch, not N.  While a dispatch runs the
+queue keeps filling, which is what lets the next batch form.
+
+Semantics:
+
+* **Backpressure** — ``submit`` never blocks; beyond
+  ``tune.serve_max_queue`` queued requests it raises
+  :class:`~dlaf_tpu.health.QueueFullError` (shed load or drain results).
+* **Deadlines** — per-request ``deadline_s`` (default: the submitter's
+  ambient ``resilience.deadline`` budget, captured at submit time).  A
+  request that expires while queued fails with
+  :class:`~dlaf_tpu.health.DeadlineExceededError` without being
+  dispatched; a dispatched group is bounded by its tightest member's
+  remaining budget through ``resilience.run_with_deadline``, so a hung
+  device fails the batch within budget instead of wedging the worker.
+* **Per-element health** — a member with ``info != 0`` (indefinite
+  matrix) still RESOLVES its future: the :class:`ServeResult` carries the
+  info code and the caller decides.  Only infrastructure failures
+  (deadline, device) reject futures.
+* **Metrics** — every request emits a ``serve``/``request_done`` record
+  with its queue latency; every dispatch emits ``serve``/``batch`` with
+  bucket, batch size and wall seconds (the roll-up in
+  ``scripts/report_metrics.py`` turns these into queue p50/p95 and
+  per-bucket throughput).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dlaf_tpu import resilience
+from dlaf_tpu.health import (
+    DeadlineExceededError,
+    DistributionError,
+    QueueFullError,
+)
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve import batched, bucketing
+
+KINDS = ("potrf", "posv", "eigh")
+
+
+@dataclass
+class ServeResult:
+    """One request's outcome: ``kind`` echoes the request, ``info`` is the
+    per-element health code (0 = success, LAPACK pivot for potrf/posv,
+    non-finite count for eigh), ``queue_s`` the submit-to-dispatch
+    latency.  Payload by kind: ``x`` holds the factor (potrf) or solution
+    (posv); ``w``/``v`` the eigenpairs (eigh)."""
+
+    kind: str
+    info: int
+    queue_s: float
+    x: np.ndarray | None = None
+    w: np.ndarray | None = None
+    v: np.ndarray | None = None
+
+
+@dataclass
+class _Request:
+    kind: str
+    uplo: str
+    a: np.ndarray
+    b: np.ndarray | None
+    squeeze: bool
+    n: int
+    bucket: int
+    future: Future
+    t_submit: float
+    expiry: float | None  # monotonic; None = unbounded
+
+    def group_key(self):
+        k = self.b.shape[-1] if self.b is not None else None
+        # eigh groups by exact order: its pad eigenpairs are compacted by
+        # the batched driver, so members must share n, not just a bucket
+        n = self.n if self.kind == "eigh" else None
+        return (self.kind, self.uplo, self.bucket, np.dtype(self.a.dtype).str, k, n)
+
+    def remaining(self) -> float | None:
+        return None if self.expiry is None else self.expiry - time.monotonic()
+
+
+def _pad_square(a: np.ndarray, n_to: int) -> np.ndarray:
+    if a.shape[0] == n_to:
+        return a
+    out = np.zeros((n_to, n_to), a.dtype)
+    out[: a.shape[0], : a.shape[0]] = a
+    idx = np.arange(a.shape[0], n_to)
+    out[idx, idx] = 1.0
+    return out
+
+
+def _pad_rows(b: np.ndarray, n_to: int) -> np.ndarray:
+    if b.shape[0] == n_to:
+        return b
+    out = np.zeros((n_to, b.shape[1]), b.dtype)
+    out[: b.shape[0]] = b
+    return out
+
+
+class SolverPool:
+    """Batched solver service over one device grid (default: all devices).
+
+    Construction knobs mirror the batched drivers: ``grid`` /
+    ``block_size`` / ``shard_batch`` / ``cache`` pass through to them;
+    ``max_queue`` / ``max_batch`` default from tune.  Use as a context
+    manager or call :meth:`close` — pending requests are cancelled on
+    close."""
+
+    def __init__(self, grid=None, *, max_queue: int | None = None,
+                 max_batch: int | None = None, cache=None,
+                 shard_batch=None, block_size=None):
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        self.grid = grid
+        self.cache = cache if cache is not None else bucketing.default_cache()
+        self.shard_batch = shard_batch
+        self.block_size = block_size
+        self.max_queue = int(max_queue if max_queue is not None else p.serve_max_queue)
+        self.max_batch = int(max_batch if max_batch is not None else p.serve_max_batch)
+        if self.max_queue < 1 or self.max_batch < 1:
+            raise DistributionError(
+                f"serve: pool bounds must be >= 1 "
+                f"(max_queue={self.max_queue}, max_batch={self.max_batch})"
+            )
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="dlaf-serve-pool", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(self, kind: str, uplo: str, a, b=None, *,
+               deadline_s: float | None = None) -> Future:
+        """Queue one problem; returns a future resolving to
+        :class:`ServeResult`.  ``kind`` in {'potrf', 'posv', 'eigh'};
+        ``posv`` needs ``b`` of shape ``(n,)`` or ``(n, k)`` (result rank
+        matches).  Raises :class:`QueueFullError` beyond ``max_queue``."""
+        if kind not in KINDS:
+            raise DistributionError(f"serve: unknown request kind {kind!r}; use {KINDS}")
+        a = np.asarray(a)
+        if a.ndim != 2 or a.shape[0] != a.shape[1]:
+            raise DistributionError(
+                f"serve: request matrix must be square 2-D, got shape {a.shape}"
+            )
+        squeeze = False
+        if kind == "posv":
+            if b is None:
+                raise DistributionError("serve: posv request needs a right-hand side b")
+            b = np.asarray(b)
+            squeeze = b.ndim == 1
+            if squeeze:
+                b = b[:, None]
+            if b.ndim != 2 or b.shape[0] != a.shape[0]:
+                raise DistributionError(
+                    f"serve: b must be (n,) or (n, k) with n={a.shape[0]}, "
+                    f"got shape {b.shape}"
+                )
+        elif b is not None:
+            raise DistributionError(f"serve: {kind} request takes no right-hand side")
+        if deadline_s is None:
+            deadline_s = resilience.remaining()
+        expiry = None if deadline_s is None else time.monotonic() + float(deadline_s)
+        req = _Request(
+            kind=kind, uplo=uplo, a=a, b=b, squeeze=squeeze, n=a.shape[0],
+            bucket=bucketing.bucket_for(a.shape[0]), future=Future(),
+            t_submit=time.monotonic(), expiry=expiry,
+        )
+        with self._cond:
+            if self._closed:
+                raise DistributionError("serve: pool is closed")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(len(self._queue), self.max_queue)
+            self._queue.append(req)
+            self._cond.notify()
+        return req.future
+
+    def result(self, future: Future, timeout: float | None = None) -> ServeResult:
+        """Wait for a submitted request (thin ``future.result`` wrapper)."""
+        return future.result(timeout)
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def close(self) -> None:
+        """Stop the worker; queued-but-undispatched requests are cancelled."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for req in stranded:
+            req.future.cancel()
+        self._worker.join(timeout=30.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                batch = [self._queue.popleft()
+                         for _ in range(min(self.max_batch, len(self._queue)))]
+            groups: dict = {}
+            for req in batch:
+                rem = req.remaining()
+                if rem is not None and rem <= 0:
+                    req.future.set_exception(
+                        DeadlineExceededError(0.0, label=f"serve:{req.kind}:queued")
+                    )
+                    continue
+                groups.setdefault(req.group_key(), []).append(req)
+            for key, reqs in groups.items():
+                try:
+                    self._dispatch(key, reqs)
+                except BaseException as exc:  # noqa: BLE001 - keep the worker alive
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_exception(exc)
+
+    def _dispatch(self, key, reqs) -> None:
+        kind, uplo, bucket, _, _, _ = key
+        t0 = time.monotonic()
+        budgets = [r.remaining() for r in reqs if r.expiry is not None]
+        seconds = min(budgets) if budgets else None
+        # potrf/posv members are padded to the common bucket order: one
+        # executable, results sliced back per element (blockdiag-identity
+        # padding is exact — see batched.py); eigh members share n already
+        # and the driver itself pads + compacts
+        if kind == "eigh":
+            a = np.stack([r.a for r in reqs])
+        else:
+            a = np.stack([_pad_square(r.a, bucket) for r in reqs])
+        try:
+            if kind == "potrf":
+                x, info = resilience.run_with_deadline(
+                    batched.batched_cholesky_factorization, uplo, a, self.grid,
+                    block_size=self.block_size, shard_batch=self.shard_batch,
+                    cache=self.cache, seconds=seconds, label=f"serve:{kind}",
+                )
+            elif kind == "posv":
+                b = np.stack([_pad_rows(r.b, bucket) for r in reqs])
+                x, info = resilience.run_with_deadline(
+                    batched.batched_positive_definite_solver, uplo, a, b,
+                    self.grid, block_size=self.block_size,
+                    shard_batch=self.shard_batch, cache=self.cache,
+                    seconds=seconds, label=f"serve:{kind}",
+                )
+            else:
+                w, v, info = resilience.run_with_deadline(
+                    batched.batched_eigensolver, uplo, a, self.grid,
+                    cache=self.cache, seconds=seconds, label=f"serve:{kind}",
+                )
+        except BaseException as exc:  # noqa: BLE001 - routed to the futures
+            for r in reqs:
+                if not r.future.cancelled():
+                    r.future.set_exception(exc)
+            return
+        elapsed = time.monotonic() - t0
+        om.emit("serve", event="batch", op=kind, bucket=str(bucket),
+                batch=len(reqs), seconds=elapsed)
+        for i, r in enumerate(reqs):
+            queue_s = t0 - r.t_submit
+            if kind == "eigh":
+                res = ServeResult(kind=kind, info=int(info[i]), queue_s=queue_s,
+                                  w=w[i][: r.n].copy(),
+                                  v=v[i][: r.n, : r.n].copy())
+            else:
+                out = x[i][: r.n, : r.n] if kind == "potrf" else x[i][: r.n, :]
+                if kind == "posv" and r.squeeze:
+                    out = out[:, 0]
+                res = ServeResult(kind=kind, info=int(info[i]),
+                                  queue_s=queue_s, x=out.copy())
+            om.emit("serve", event="request_done", op=kind, bucket=str(bucket),
+                    queue_s=queue_s, info=int(info[i]))
+            if not r.future.cancelled():
+                r.future.set_result(res)

@@ -115,6 +115,21 @@ class TuneParameters:
       the resolved tier (collectives.collectives_trace_key), so flipping
       it between calls retraces correctly.  True multi-contributor sums
       (psum_axis) are reductions in every tier.
+    - ``serve_buckets``: comma-separated problem orders the serve layer
+      pads requests up to (``dlaf_tpu.serve``); a request of order n runs
+      at the smallest bucket >= n, sizes beyond the largest round up to a
+      multiple of it.  Fewer buckets = fewer compiles, more padding flops.
+    - ``serve_cache_capacity``: bound on the serve layer's LRU of compiled
+      bucket executables; least-recently-used buckets are evicted (and
+      counted) beyond this.
+    - ``serve_batch_shard_max_n``: batched drivers shard the BATCH axis
+      across all devices (one element per device, collectives degenerate)
+      when the problem order is <= this; larger problems keep the matrix
+      axes sharded and vmap the batch locally.
+    - ``serve_max_queue``: SolverPool backpressure bound — submissions
+      beyond this many queued requests raise ``QueueFullError``.
+    - ``serve_max_batch``: most requests the pool worker fuses into one
+      batched dispatch.
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -151,6 +166,17 @@ class TuneParameters:
     # CPU-validated (interpret-mode parity tests), DEFAULT OFF until an
     # on-hardware A/B justifies them — nothing lands unmeasured.
     collectives_impl: str = field(default_factory=lambda: _env("collectives_impl", "auto", str))
+    serve_buckets: str = field(
+        default_factory=lambda: _env("serve_buckets", "256,512,1024,2048", str)
+    )
+    serve_cache_capacity: int = field(
+        default_factory=lambda: _env("serve_cache_capacity", 16, int)
+    )
+    serve_batch_shard_max_n: int = field(
+        default_factory=lambda: _env("serve_batch_shard_max_n", 1024, int)
+    )
+    serve_max_queue: int = field(default_factory=lambda: _env("serve_max_queue", 256, int))
+    serve_max_batch: int = field(default_factory=lambda: _env("serve_max_batch", 64, int))
     panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
     dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(

@@ -188,6 +188,41 @@ def summarize(path: str) -> int:
             )
             print(f"   rank {r['rank']}  {r['event']}" + (f"  {detail}" if detail else ""))
 
+    serve = by_kind.get("serve", [])
+    if serve:
+        counts = defaultdict(int)
+        for r in serve:
+            counts[r["event"]] += 1
+        hits, misses = counts.get("cache_hit", 0), counts.get("cache_miss", 0)
+        rate = hits / (hits + misses) if hits + misses else 0.0
+        print(f"-- serve ({len(serve)} events):")
+        print(f"   compile cache: {hits} hits / {misses} misses "
+              f"({100 * rate:.0f}% hit rate), {counts.get('compile', 0)} compiles, "
+              f"{counts.get('cache_evict', 0)} evictions")
+        lat = sorted(r["queue_s"] for r in serve
+                     if r["event"] == "request_done" and "queue_s" in r)
+        if lat:
+            p50 = lat[len(lat) // 2]
+            p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+            print(f"   queue latency: p50 {p50 * 1e3:.1f} ms  "
+                  f"p95 {p95 * 1e3:.1f} ms  ({len(lat)} requests)")
+        # per-bucket roll-up: requests and fused-dispatch throughput
+        per_bucket = defaultdict(lambda: [0, 0, 0.0])  # reqs, batches, seconds
+        for r in serve:
+            if r["event"] == "request_done":
+                per_bucket[r.get("bucket", "?")][0] += 1
+            elif r["event"] == "batch":
+                pb = per_bucket[r.get("bucket", "?")]
+                pb[1] += 1
+                pb[2] += float(r.get("seconds", 0.0))
+        rows = {b: v for b, v in per_bucket.items() if v[0] or v[1]}
+        if rows:
+            print(f"   {'bucket':>10s} {'requests':>9s} {'batches':>8s} "
+                  f"{'problems/s':>11s}")
+            for b, (nreq, nbatch, secs) in sorted(rows.items()):
+                thr = f"{nreq / secs:11.1f}" if secs and nreq else f"{'-':>11s}"
+                print(f"   {b:>10s} {nreq:9d} {nbatch:8d} {thr}")
+
     for r in by_kind.get("note", []):
         print(f"-- note (rank {r['rank']}): {r['text']}")
     return 0

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Drive the batched solver service once and write its events to a metrics file.
+
+Usage: python scripts/serve_smoke.py out.jsonl
+
+CI runs this as the serve lane's artifact step: a mixed-shape request
+stream goes through the PRODUCTION path — shape bucketing, the bounded
+compile cache (including one forced eviction), the async SolverPool with
+grouping, backpressure and queue deadlines — and the resulting ``serve``
+records land in ``out.jsonl`` for ``scripts/report_metrics.py``.  Exit is
+nonzero if any check fails.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np
+
+from dlaf_tpu import serve, tune
+from dlaf_tpu.health import DeadlineExceededError, QueueFullError
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.testing import faults, random_hermitian_pd, random_matrix
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = argv[0] if argv else "serve.jsonl"
+    om.enable(path)
+    om.emit_run_meta("serve_smoke")
+    tune.initialize(serve_buckets="16,32,48")
+    failures = []
+
+    def expect(cond, what):
+        print(("ok  " if cond else "FAIL") + f"  {what}")
+        if not cond:
+            failures.append(what)
+
+    # 1. mixed-shape stream through the batched drivers: 3 buckets, one
+    # executable each, every later shape a cache hit
+    cache = serve.CompiledCache(capacity=8)
+    for i, n in enumerate((12, 24, 40, 16, 30, 48)):
+        a = np.stack([random_hermitian_pd(n, np.float32, seed=10 * i + j)
+                      for j in range(2)])
+        _, info = serve.batched_cholesky_factorization(
+            "L", a, block_size=8, shard_batch=True, cache=cache
+        )
+        expect(np.all(info == 0), f"potrf stream n={n} info clean")
+    expect(cache.counters["miss"] == 3, f"3 compiles for 3 buckets: {cache.counters}")
+    expect(cache.counters["hit"] == 3, f"repeat buckets hit: {cache.counters}")
+
+    # 2. bounded cache: capacity 2 forces an eviction on the third bucket
+    small = serve.CompiledCache(capacity=2)
+    for n in (16, 32, 48):
+        a = np.stack([random_hermitian_pd(n, np.float32, seed=n)])
+        serve.batched_cholesky_factorization(
+            "L", a, block_size=8, shard_batch=True, cache=small
+        )
+    expect(small.counters["evict"] == 1 and len(small) == 2,
+           f"LRU eviction under cap 2: {small.counters}")
+
+    # 3. per-element health: one broken SPD member reports its own pivot
+    a = np.stack([random_hermitian_pd(32, np.float32, seed=70 + j)
+                  for j in range(4)])
+    a[2] = faults.break_spd(a[2], 5)
+    _, info = serve.batched_cholesky_factorization(
+        "L", a, block_size=8, shard_batch=True, cache=cache
+    )
+    expect(info[2] == 6 and np.all(info[[0, 1, 3]] == 0),
+           f"info isolation across the batch: {list(info)}")
+
+    # 4. the pool: mixed kinds resolve, grouping shares executables,
+    # backpressure and queue deadlines reject crisply
+    with serve.SolverPool(block_size=8, cache=cache) as pool:
+        spd = random_hermitian_pd(24, np.float32, seed=90)
+        rhs = random_matrix(24, 2, np.float32, seed=91)
+        f1 = pool.submit("potrf", "L", spd)
+        f2 = pool.submit("posv", "L", spd, rhs)
+        f3 = pool.submit("eigh", "L", spd)
+        r1, r2, r3 = (pool.result(f, timeout=300) for f in (f1, f2, f3))
+        low = np.tril(r1.x)
+        expect(r1.info == 0 and np.abs(low @ low.T - spd).max() < 1e-3,
+               "pool potrf factors")
+        expect(r2.info == 0 and np.abs(spd @ r2.x - rhs).max() < 1e-3,
+               "pool posv solves")
+        expect(r3.info == 0
+               and np.abs(spd @ r3.v - r3.v * r3.w[None, :]).max() < 1e-3,
+               "pool eigh decomposes")
+        try:
+            pool.result(pool.submit("potrf", "L", spd, deadline_s=0.0), 300)
+            expect(False, "queued past its deadline should fail")
+        except DeadlineExceededError:
+            expect(True, "expired-in-queue request rejected pre-dispatch")
+
+    # backpressure on a gated pool (worker held so the queue must fill)
+    gate = threading.Event()
+    pool = serve.SolverPool(max_queue=1, block_size=8, cache=cache)
+    orig = pool._dispatch
+    pool._dispatch = lambda key, reqs: (gate.wait(60.0), orig(key, reqs))
+    try:
+        fa = pool.submit("potrf", "L", spd)
+        import time as _t
+        t0 = _t.monotonic()
+        while pool.pending() and _t.monotonic() - t0 < 10.0:
+            _t.sleep(0.005)
+        fb = pool.submit("potrf", "L", spd)
+        try:
+            pool.submit("potrf", "L", spd)
+            expect(False, "over-capacity submit should raise QueueFullError")
+        except QueueFullError as e:
+            expect(e.size == 1 and e.capacity == 1,
+                   f"QueueFullError carries occupancy: {e}")
+        gate.set()
+        expect(pool.result(fa, 300).info == 0 and pool.result(fb, 300).info == 0,
+               "gated requests complete after release")
+    finally:
+        gate.set()
+        pool.close()
+
+    om.close()
+    recs = [r for r in om.read_jsonl(path) if r["kind"] == "serve"]
+    done = [r for r in recs if r["event"] == "request_done"]
+    expect(len(done) >= 5, f"request_done events recorded: {len(done)}")
+    expect(all(r["queue_s"] >= 0 for r in done), "queue latencies non-negative")
+    expect(sum(r["event"] == "cache_evict" for r in recs) >= 1,
+           "eviction event in the stream")
+    expect(sum(r["event"] == "compile" for r in recs) >= 3,
+           "compile events in the stream")
+
+    print(("PASS" if not failures else "FAIL") + f"  serve_smoke ({len(recs)} serve events)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,6 +278,53 @@ def case_potrf_ckpt(grid, args):
         os.remove(path)
 
 
+def case_serve_batched(grid, args):
+    """dlaf_tpu.serve batched drivers with the BATCH axis sharded across
+    the processes' devices: every process submits the same host batch,
+    each rank's devices factor/solve their local batch elements, and the
+    replicated gather hands every process the full result stack."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu import serve, tune
+    from dlaf_tpu.serve.bucketing import CompiledCache
+
+    tune.initialize(serve_buckets=str(args.n))
+    B, n, nb = 8, args.n, args.nb
+    a = np.stack(
+        [tu.random_hermitian_pd(n, np.float32, seed=60 + i) for i in range(B)]
+    )
+    rng = np.random.default_rng(61)
+    b = rng.standard_normal((B, n, 2)).astype(np.float32)
+    cache = CompiledCache()
+    tol = tu.tol_for(np.float32, n, 100.0)
+
+    ell, info = serve.batched_cholesky_factorization(
+        "L", a, grid, block_size=nb, shard_batch=True, cache=cache
+    )
+    assert info.shape == (B,) and np.all(info == 0), info
+    for i in range(B):
+        low = np.tril(ell[i])
+        res = np.max(np.abs(low @ low.T - a[i]))
+        assert res < tol * np.abs(a[i]).max(), (i, res)
+
+    x, info = serve.batched_positive_definite_solver(
+        "L", a, b, grid, block_size=nb, shard_batch=True, cache=cache
+    )
+    assert np.all(info == 0), info
+    for i in range(B):
+        res = np.max(np.abs(a[i] @ x[i] - b[i]))
+        scale = np.abs(a[i]).max() * max(np.abs(x[i]).max(), 1.0)
+        assert res < tol * scale, (i, res)
+
+    # cached executable, same inputs: the service path is deterministic
+    x2, _ = serve.batched_positive_definite_solver(
+        "L", a, b, grid, block_size=nb, shard_batch=True, cache=cache
+    )
+    np.testing.assert_array_equal(x, x2)
+    assert cache.counters["miss"] == 2 and cache.counters["hit"] == 1
+
+
 CASES = {
     "roundtrip": case_roundtrip,
     "hdf5": case_hdf5,
@@ -288,6 +335,7 @@ CASES = {
     "hegv": case_hegv,
     "heev_c128": case_heev_c128,
     "scalapack_local": case_scalapack_local,
+    "serve_batched": case_serve_batched,
 }
 
 

@@ -76,6 +76,15 @@ def test_mp2_potrf_ckpt_resume():
     run_world(2, 4, "potrf_ckpt", n=32, nb=8)
 
 
+def test_mp2_serve_batched():
+    """2 processes x 4 devices: serve batched potrf/posv with the BATCH
+    axis sharded across processes — each rank's devices own a slice of the
+    batch, gathers replicate the full result stack, and the bucketed
+    compile cache serves the repeat call (ISSUE 5 in the real
+    multi-process world)."""
+    run_world(2, 4, "serve_batched", n=32, nb=8)
+
+
 def test_mp4_potrf():
     """4 processes x 2 devices (2x4 grid): distributed Cholesky residual."""
     run_world(4, 2, "potrf", n=32, nb=8)

@@ -100,3 +100,51 @@ def test_posv_mixed_no_fallback_reports(grid_2x4):
         "L", mat_a, mat_b, max_iters=4, fallback=False
     )
     assert not info.converged and not info.fallback
+
+
+def test_posv_b_geometry_validated_up_front(grid_2x4):
+    """A mismatched B must fail fast as DistributionError at the driver
+    boundary (naming the mismatch), not as a raw XLA shape error deep in
+    the trsm kernel — and multi-RHS (N, k) stacks must pass."""
+    from dlaf_tpu.health import DistributionError
+
+    m, mb = 16, 4
+    a = tu.random_hermitian_pd(m, np.float64, seed=2)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (mb, mb))
+
+    # multi-RHS stack is first-class
+    b = tu.random_matrix(m, 5, np.float64, seed=3)
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    x = positive_definite_solver("L", mat_a, mat_b)
+    tu.assert_near(x, np.linalg.solve(a, b), tu.tol_for(np.float64, m, 500.0))
+
+    # wrong row count
+    bad_rows = DistributedMatrix.from_global(
+        grid_2x4, tu.random_matrix(m + mb, 2, np.float64, seed=4), (mb, mb)
+    )
+    with pytest.raises(DistributionError, match="rows to match"):
+        positive_definite_solver("L", mat_a, bad_rows)
+    # ValueError compatibility for pre-taxonomy callers
+    with pytest.raises(ValueError):
+        positive_definite_solver("L", mat_a, bad_rows)
+
+    # mismatched row tiling
+    bad_tiles = DistributedMatrix.from_global(
+        grid_2x4, tu.random_matrix(m, 2, np.float64, seed=5), (mb * 2, mb * 2)
+    )
+    with pytest.raises(DistributionError, match="row tiling"):
+        positive_definite_solver("L", mat_a, bad_tiles)
+
+    # bad uplo string
+    good_b = DistributedMatrix.from_global(
+        grid_2x4, tu.random_matrix(m, 2, np.float64, seed=6), (mb, mb)
+    )
+    with pytest.raises(DistributionError, match="uplo"):
+        positive_definite_solver("X", mat_a, good_b)
+
+    # cholesky_solver shares the gate
+    fac = cholesky_factorization("L", DistributedMatrix.from_global(
+        grid_2x4, np.tril(a), (mb, mb)
+    ))
+    with pytest.raises(DistributionError, match="rows to match"):
+        cholesky_solver("L", fac, bad_rows)
