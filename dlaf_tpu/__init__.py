@@ -12,6 +12,7 @@ auxiliary}.h).
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index import Index2D, Size2D
 from dlaf_tpu.health import (
+    ConfigurationError,
     ConvergenceError,
     DeadlineExceededError,
     DeviceUnresponsiveError,
@@ -72,6 +73,7 @@ __all__ = [
     "Size2D",
     "DlafError",
     "NotPositiveDefiniteError",
+    "ConfigurationError",
     "ConvergenceError",
     "DistributionError",
     "NonFiniteError",

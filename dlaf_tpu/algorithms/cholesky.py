@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from dlaf_tpu.algorithms._origin import origin_transparent
 
+from contextlib import nullcontext as _nullcontext
 from functools import partial
 
 import jax
@@ -42,6 +43,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.common import stagetimer as st
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.obs.comms import record as _rec_comms
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
 
@@ -57,6 +59,31 @@ def _diag_potrf(d):
     except Exception:
         pass
     return t.potrf(d, lower=True)
+
+
+def _fused_panel_bcast(d, xc, below, root, overlap: bool):
+    """Fused factor-and-send for the lookahead panel: one Pallas kernel
+    composing the potrf sweep, the column-blocked panel trsm, and the
+    remote-DMA ring broadcast (ops/pallas_panel_exchange.fused_factor_bcast)
+    so the panel starts streaming the moment it is factored.  Engages only
+    under the pallas collectives tier on a real TPU backend (the exchange
+    needs ICI DMA); returns None to take the unfused path otherwise —
+    identical math either way."""
+    try:
+        from dlaf_tpu.ops import pallas_panel_exchange as ppe
+
+        if (
+            coll.collectives_trace_key() == "pallas"
+            and jax.default_backend() == "tpu"
+            and coll.axis_size(COL_AXIS) > 1
+            and ppe.fusion_supported(d, xc)
+        ):
+            lkk, cp = ppe.fused_factor_bcast(d, xc, below, root, COL_AXIS)
+            _rec_comms("bcast_pallas", xc, COL_AXIS, overlapped=overlap)
+            return lkk, cp
+    except Exception:
+        pass
+    return None
 
 
 def _pivot_scan(d):
@@ -258,23 +285,39 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     run the bulk trailing update excluding column k+1.  Panel k+1's
     collectives are independent of the bulk einsum, so XLA can overlap them
     — panel broadcast latency hides under the trailing update on real
-    meshes.  The panel flows through the loop carry."""
+    meshes.  The panel flows through the loop carry.
+
+    The steady-state panel exchanges (everything issued from the loop body;
+    the prologue's panel-0 broadcast has nothing to hide under) run inside
+    ``coll.overlap_window``: under the pallas collectives tier their DMA
+    hops can drain beneath the bulk einsum and ``obs.comms`` books their
+    modeled wire bytes as overlapped, and on TPU the panel factor+broadcast
+    collapses into the fused Pallas step (``_fused_panel_bcast``)."""
     x = coll.local(x)
     myr, myc = coll.my_rank()
     x = _spmd.pad_diag_identity(x, g, myr, myc)
     gi = _spmd.local_row_tiles(g, myr)
     gj = _spmd.local_col_tiles(g, myc)
 
-    def compute_panel(x, k):
-        with _scope("chol.diag_potrf"):
+    def compute_panel(x, k, overlap=False):
+        # overlap=True: this is the lookahead panel — every collective in
+        # its dependency chain (diag-tile bcast included) is independent of
+        # the bulk einsum it is scheduled against, so the whole chain sits
+        # inside the window
+        win = coll.overlap_window if overlap else _nullcontext
+        with _scope("chol.diag_potrf"), win():
             d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
-            lkk = _diag_potrf(d)
             bad = _pivot_scan(d) if want_info else None
+        xc = _spmd.take_col(x, k // g.pc, g)
+        fused = _fused_panel_bcast(d, xc, gi > k, k % g.pc, overlap)
+        if fused is not None:
+            return fused[0], fused[1], bad
+        with _scope("chol.diag_potrf"):
+            lkk = _diag_potrf(d)
         with _scope("chol.panel_trsm"):
-            xc = _spmd.take_col(x, k // g.pc, g)
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xc)
             below = (gi > k)[:, None, None]
-        with _scope("chol.panel_bcast"):
+        with _scope("chol.panel_bcast"), win():
             cp = coll.bcast(
                 jnp.where(below, pan, jnp.zeros_like(pan)), k % g.pc, COL_AXIS
             )
@@ -297,7 +340,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         else:
             x, lkk, cp = carry
         x = write_back(x, k, lkk, cp)
-        with _scope("chol.panel_bcast"):
+        with _scope("chol.panel_bcast"), coll.overlap_window():
             rp = coll.transpose_panel(cp, g.mt, g.ltc)
         # narrow update: column k+1 only, so its panel can start immediately
         l_next = (k + 1) // g.pc
@@ -307,7 +350,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
         x = _spmd.put_col(x, xc1, l_next)
         # lookahead: panel k+1 from the already-updated column
-        lkk1, cp1, bad1 = compute_panel(x, k + 1)
+        lkk1, cp1, bad1 = compute_panel(x, k + 1, overlap=True)
         if want_info:
             info = jnp.where((info == 0) & (bad1 > 0), (k + 1) * g.mb + bad1, info)
         # bulk trailing update, column k+1 excluded (already updated)

@@ -29,13 +29,21 @@ two implementations are interchangeable:
   all; out-of-range slots are zero-filled locally.  Semantically a true
   broadcast (reference broadcast_panel.h / kernels/broadcast.h), modeled at
   ``(P-1)/P * payload`` wire bytes per device — half the reduce tier.
+* ``'pallas'`` — the same one-contributor semantics as a neighbor ring in
+  Pallas kernels (``ops/pallas_panel_exchange``): on TPU one fused
+  ``pltpu.make_async_remote_copy`` kernel whose DMA hops can drain under
+  the trailing MXU work (collectives issued inside an
+  :func:`overlap_window` report their modeled wire bytes as *overlapped*);
+  on CPU/interpret backends the identical ring schedule with ppermute
+  transport and the interpret-mode merge kernel.  Bit-identical to v2 by
+  construction (pure copies/selects), same ``(P-1)/P`` modeled wire cost.
 
 Selection: ``tune.TuneParameters.collectives_impl``
-(``'psum' | 'v2' | 'auto'``, env ``DLAF_TPU_COLLECTIVES_IMPL``; ``'auto'``
-= v2 on accelerator backends, psum on CPU until measured).  The knob is
-read at TRACE time — compiled-kernel caches must include
-:func:`collectives_trace_key` or flipping the knob would silently reuse
-stale executables.
+(``'psum' | 'v2' | 'pallas' | 'auto'``, env ``DLAF_TPU_COLLECTIVES_IMPL``;
+``'auto'`` = v2 on accelerator backends, psum on CPU until measured —
+never pallas until a live TPU A/B lands).  The knob is read at TRACE time
+— compiled-kernel caches must include :func:`collectives_trace_key` or
+flipping the knob would silently reuse stale executables.
 
 All functions assume they run inside ``shard_map`` over a mesh with axes
 ``('r', 'c')`` (see grid.ROW_AXIS/COL_AXIS).
@@ -52,6 +60,8 @@ single-column grid) and ``shift`` by a multiple of the axis size emit no
 collective ops at all (and report nothing — there is no traffic).
 """
 from __future__ import annotations
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
@@ -82,20 +92,20 @@ def grid_shape():
 
 
 def _impl() -> str:
-    """Resolve ``tune.collectives_impl`` to the active tier ('psum'|'v2').
+    """Resolve ``tune.collectives_impl`` to the active tier
+    ('psum'|'v2'|'pallas').
 
     ``'auto'`` picks v2 on accelerator backends and psum on CPU (where the
-    masked all-reduce benchmarks at parity and stays the measured default).
-    Read lazily so comm does not import tune at module load."""
+    masked all-reduce benchmarks at parity and stays the measured default);
+    it never resolves to pallas — that tier is explicit-opt-in until a live
+    TPU A/B (scripts/tpu_day.sh stage 5f) justifies promotion.  Read lazily
+    so comm does not import tune at module load."""
     from dlaf_tpu import tune
 
     impl = tune.get_tune_parameters().collectives_impl
     if impl == "auto":
         return "v2" if jax.default_backend() != "cpu" else "psum"
-    if impl not in ("psum", "v2"):
-        raise ValueError(
-            f"collectives_impl must be 'psum', 'v2' or 'auto', got {impl!r}"
-        )
+    tune.validate_collectives_impl(impl)  # ConfigurationError on typos
     return impl
 
 
@@ -106,6 +116,36 @@ def collectives_trace_key() -> str:
     knob — flipping ``collectives_impl`` between calls must retrace, not
     silently reuse an executable traced under the other tier."""
     return _impl()
+
+
+# ------------------------------------------------------------ overlap scope
+
+_overlap_depth = 0
+
+
+@contextlib.contextmanager
+def overlap_window():
+    """Mark the enclosed collectives as schedulable under trailing compute.
+
+    Algorithms enter this around panel exchanges whose results the next
+    bulk phase does NOT immediately need (the lookahead dataflow pattern).
+    It never changes what is computed — only how ``obs.comms`` classifies
+    the modeled wire bytes: the pallas tier's DMA hops can drain while the
+    MXU runs, so its records inside a window count as *overlapped*; the
+    psum/v2 tiers lower to XLA collectives that barrier regardless, so
+    their bytes stay *exposed* even here.  That split is the modeled win
+    ``scripts/report_metrics.py`` prints and the tpu_day A/B measures."""
+    global _overlap_depth
+    _overlap_depth += 1
+    try:
+        yield
+    finally:
+        _overlap_depth -= 1
+
+
+def _rec_tier(kind: str, x, axis: str) -> None:
+    """Record a pallas-tier collective, overlapped iff inside a window."""
+    _rec(kind, x, axis, overlapped=_overlap_depth > 0)
 
 
 def _forward_chain(y, have, axis: str):
@@ -143,11 +183,19 @@ def bcast(x, root, axis: str):
     psum tier: a psum of root-masked data — O(log P) on ICI, no explicit
     send/recv pairing (replaces schedule_bcast_send/recv).  v2 tier: a
     doubling ppermute chain seeded at the (traced) root — a true one-
-    contributor broadcast with no add-tree.  Size-1 axes are the identity."""
+    contributor broadcast with no add-tree.  pallas tier: the neighbor-ring
+    DMA kernel seeded the same way (ops/pallas_panel_exchange).  Size-1
+    axes are the identity."""
     if axis_size(axis) == 1:
         return x
     me = lax.axis_index(axis)
-    if _impl() == "v2":
+    impl = _impl()
+    if impl == "pallas":
+        from dlaf_tpu.ops import pallas_panel_exchange as ppe
+
+        _rec_tier("bcast_pallas", x, axis)
+        return ppe.ring_bcast(x, me == root, axis)
+    if impl == "v2":
         _rec("bcast_v2", x, axis)
         y, _ = _forward_chain(x, me == root, axis)
         return y
@@ -214,7 +262,15 @@ def _panel_exchange(taken, have, axis: str):
     hmask = have.reshape(have.shape + (1,) * (taken.ndim - have.ndim))
     if axis_size(axis) == 1:
         return jnp.where(hmask, taken, jnp.zeros_like(taken))
-    if _impl() == "v2":
+    impl = _impl()
+    if impl == "pallas":
+        from dlaf_tpu.ops import pallas_panel_exchange as ppe
+
+        _rec_tier("transpose_panel_pallas", taken, axis)
+        y, have_all = ppe.ring_exchange(taken, have, axis)
+        amask = have_all.reshape(have_all.shape + (1,) * (y.ndim - have_all.ndim))
+        return jnp.where(amask, y, jnp.zeros_like(y))
+    if impl == "v2":
         _rec("transpose_panel_v2", taken, axis)
         y, have_all = _forward_chain(taken, have, axis)
         amask = have_all.reshape(have_all.shape + (1,) * (y.ndim - have_all.ndim))

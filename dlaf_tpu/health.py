@@ -76,6 +76,13 @@ class DistributionError(DlafError, ValueError):
     pre-taxonomy callers catching ``ValueError`` keep working."""
 
 
+class ConfigurationError(DlafError, ValueError):
+    """A tune/config knob holds a value outside its documented domain
+    (e.g. a typo'd ``DLAF_TPU_COLLECTIVES_IMPL``).  Subclasses
+    ``ValueError`` so pre-taxonomy callers catching ``ValueError`` keep
+    working."""
+
+
 class NonFiniteError(DlafError, ArithmeticError):
     """A stage-boundary sentinel found NaN/Inf.  ``stage`` names the first
     pipeline stage whose output went non-finite."""

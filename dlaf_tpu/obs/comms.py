@@ -31,13 +31,25 @@ Modeled wire bytes
 Next to the logical payload, each record carries an analytic ring-model
 wire cost per device (:func:`wire_model`), keyed on the collective *kind*:
 reduce-tier redistributions (``psum``/``bcast``/``transpose_panel``) cost a
-full all-reduce ``2(P-1)/P * payload``; the v2 one-contributor tier
-(``bcast_v2``/``transpose_panel_v2``) delivers each payload byte across
-``P-1`` links once, ``(P-1)/P * payload`` per device — the "modeled bytes
-saved" figure ``scripts/report_metrics.py`` prints is the difference.  It
-is a model of the semantic redistribution on a ring, deliberately NOT a
-count of the instructions XLA emits (which vary by backend and version);
-like the payload column it is exact, comparable, and hardware-free.
+full all-reduce ``2(P-1)/P * payload``; the one-contributor tiers
+(``*_v2`` doubling chain, ``*_pallas`` neighbor ring) deliver each payload
+byte across ``P-1`` links once, ``(P-1)/P * payload`` per device — the
+"modeled bytes saved" figure ``scripts/report_metrics.py`` prints is the
+difference.  It is a model of the semantic redistribution on a ring,
+deliberately NOT a count of the instructions XLA emits (which vary by
+backend and version); like the payload column it is exact, comparable, and
+hardware-free.
+
+Overlapped wire bytes
+---------------------
+The fourth accumulator column splits the modeled wire bytes into exposed
+vs *overlapped*: a record with ``overlapped=True`` (the pallas DMA tier
+issuing inside a ``collectives.overlap_window`` — an exchange whose hops
+can drain under trailing compute) contributes its modeled bytes to both
+the wire total and the overlapped column.  ``exposed = wire - overlapped``
+is the latency a panel step actually waits on; the psum/v2 tiers are hard
+XLA barriers, never overlapped, which is exactly the modeled difference
+the three-way A/B in ``scripts/collectives_ab.py`` reports.
 """
 from __future__ import annotations
 
@@ -47,7 +59,8 @@ import numpy as np
 from jax import lax
 
 # (kind, dtype, axis, axis_size) ->
-#     [call_count, payload_bytes_total, modeled_wire_bytes_total]
+#     [call_count, payload_bytes_total, modeled_wire_bytes_total,
+#      overlapped_wire_bytes_total]
 _acc: dict | None = None
 
 
@@ -59,7 +72,8 @@ def start() -> None:
 
 def stop() -> dict:
     """Stop accounting and return {(kind, dtype, axis, axis_size):
-    [count, bytes, modeled_wire_bytes]} in first-seen order."""
+    [count, bytes, modeled_wire_bytes, overlapped_wire_bytes]} in
+    first-seen order."""
     global _acc
     acc, _acc = _acc or {}, None
     return acc
@@ -80,13 +94,13 @@ def wire_model(kind: str, axis_size: int, nbytes: int) -> int:
 
     Unknown axis contexts (axis_size 0) model as free — there is no ring to
     cost.  Kinds: reduce-tier redistributions and true sums are ring
-    all-reduces; v2 one-contributor redistributions deliver each byte over
-    P-1 links once; ``shift`` is one neighbor hop; ``all_gather``
-    materializes the other P-1 blocks."""
+    all-reduces; the one-contributor tiers (v2 doubling chain, pallas
+    neighbor ring) deliver each byte over P-1 links once; ``shift`` is one
+    neighbor hop; ``all_gather`` materializes the other P-1 blocks."""
     p = int(axis_size)
     if p <= 1:
         return 0
-    if kind.endswith("_v2"):
+    if kind.endswith("_v2") or kind.endswith("_pallas"):
         return round((p - 1) * nbytes / p)
     if kind == "shift":
         return nbytes
@@ -96,10 +110,13 @@ def wire_model(kind: str, axis_size: int, nbytes: int) -> int:
     return round(2 * (p - 1) * nbytes / p)
 
 
-def record(kind: str, x, axis: str | None = None) -> None:
+def record(kind: str, x, axis: str | None = None, overlapped: bool = False) -> None:
     """Account one collective call site: ``x`` is the operand about to be
     handed to the ``lax`` collective, ``axis`` its mesh axis (None for 2D /
-    axis-free ops).  Runs at trace time only; no-op unless :func:`start`."""
+    axis-free ops).  ``overlapped=True`` classifies the modeled wire bytes
+    as drainable under trailing compute (pallas DMA tier inside a
+    ``collectives.overlap_window``).  Runs at trace time only; no-op unless
+    :func:`start`."""
     if _acc is None:
         return
     try:
@@ -108,17 +125,22 @@ def record(kind: str, x, axis: str | None = None) -> None:
         size = 0
     nbytes = math.prod(x.shape) * np.dtype(x.dtype).itemsize
     key = (kind, np.dtype(x.dtype).name, axis or "", int(size))
-    ent = _acc.setdefault(key, [0, 0, 0])
+    ent = _acc.setdefault(key, [0, 0, 0, 0])
+    while len(ent) < 4:  # legacy accumulations started before this column
+        ent.append(0)
+    wire = wire_model(kind, int(size), nbytes)
     ent[0] += 1
     ent[1] += nbytes
-    ent[2] += wire_model(kind, int(size), nbytes)
+    ent[2] += wire
+    ent[3] += wire if overlapped else 0
 
 
 def as_records(acc: dict) -> list:
     """Render an accumulation dict into JSON-ready row dicts (one per
-    (kind, dtype, axis, axis_size) bucket).  Accepts legacy two-element
-    values (pre-wire-model accumulations) and models their wire bytes on
-    the fly."""
+    (kind, dtype, axis, axis_size) bucket).  Accepts legacy two- and
+    three-element values (pre-wire-model / pre-overlap accumulations),
+    modeling missing wire bytes on the fly and treating missing overlap as
+    fully exposed."""
     rows = []
     for (kind, dtype, axis, size), val in acc.items():
         count, nbytes = val[0], val[1]
@@ -132,6 +154,7 @@ def as_records(acc: dict) -> list:
                 "messages": count,
                 "bytes": nbytes,
                 "modeled_wire_bytes": wire,
+                "overlapped_wire_bytes": val[3] if len(val) > 3 else 0,
             }
         )
     return rows

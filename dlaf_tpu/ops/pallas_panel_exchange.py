@@ -1,0 +1,448 @@
+"""Pallas TPU distributed panel exchange: ring DMA with compute overlap.
+
+The third collectives tier (``tune.collectives_impl='pallas'``).  The psum
+and v2 tiers both lower to XLA collectives — hard barriers between the
+factor, exchange, and trailing-update phases of every panel step.  This
+module moves the one-contributor panel redistributions
+(``comm.collectives``: ``bcast`` and the ``transpose_panel*`` family) into
+Pallas kernels built on ``pltpu.make_async_remote_copy`` so the factored
+panel streams over ICI neighbor links on the DMA engines **while** the
+previous iteration's trailing GEMM still owns the MXU — the DLA-Future
+lookahead/dataflow model (PAPER.md L2/L6) done with async DMA instead of a
+task runtime (the pattern of SNIPPETS.md [1]/[3]).
+
+Schedule
+--------
+Everything here is one ring: ``P-1`` unconditional neighbor hops along the
+mesh axis.  Each rank carries a ``(payload, have)`` pair — ``have[slot]``
+marks the slots whose payload bytes this rank has contributed or received.
+Per hop every rank sends its current pair one step right and merges the
+incoming pair with pure copies/selects::
+
+    take = ~have & have_in
+    y    = where(take, y_in, y)        # contributor bytes, verbatim
+    have |= have_in
+
+After ``P-1`` hops every rank holds the union of all contributions.  Every
+slot has at most one contributor, so the merge never mixes values — the
+result is BIT-identical to the v2 doubling chain (and to the psum tier's
+masked all-reduce), which is what lets ``tests/test_collectives_pallas.py``
+assert exact equality rather than tolerances.
+
+Why a ring and not the v2 doubling chain: doubling needs hop distances
+1, 2, 4, ... (non-neighbor links, routed on real ICI), while the ring uses
+only nearest neighbors — exactly what ``make_async_remote_copy`` streams
+fastest — and its per-hop data dependence is one deterministic neighbor,
+which is what makes the double-buffered overlap safe (see below).
+
+Execution paths
+---------------
+``ring_exchange`` picks per backend at trace time:
+
+* **TPU**: one fused ``pallas_call`` (``_dma_ring_kernel``) running all
+  ``P-1`` hops with double-buffered VMEM landing slots and per-slot DMA
+  send/recv semaphores.  Deadlock freedom: every rank starts its
+  (unconditional) send *before* waiting on its recv semaphore, so a rank
+  delayed by skew stalls its neighbors at the semaphore wait — never a
+  cycle.  Two landing slots suffice because a neighbor can run at most one
+  hop ahead: its hop ``s+2`` send into slot ``s%2`` is ordered after it
+  received our hop ``s+1`` payload, which we send only after consuming
+  slot ``s%2``.
+* **CPU / interpret (the tier-1 mesh)**: the identical ring schedule with
+  the hop transport as ``lax.ppermute`` and the per-hop merge as a Pallas
+  kernel in interpret mode — the jax-0.4.37 interpreter only discharges
+  remote DMA over a single named mesh axis, so on the 2D ('r','c') grid
+  the kernel under test is the merge, and the remote-copy kernel itself is
+  exercised by the single-axis interpret tests in
+  ``tests/test_collectives_pallas.py``.  Interpret-mode constraint: Pallas
+  outputs must be numeric (bool outputs crash the 0.4.37 interpreter), so
+  ``have`` masks travel as int32 and complex payloads travel as
+  bit-preserving float pair views (``.view()`` roundtrips exactly).
+
+``fused_factor_bcast`` composes the existing ``ops/pallas_potrf`` and
+``ops/pallas_panel_trsm`` kernel bodies with the DMA ring in ONE
+``pallas_call``: the diagonal tile factors and the panel solve runs with
+everything VMEM-resident, and the factored panel starts streaming to the
+ring the moment it exists — no HBM round-trip, no XLA barrier between
+factor and exchange.  TPU-only (gated by ``fusion_supported``); the CPU
+mesh keeps the unfused path, which is the same math.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dlaf_tpu.ops import pallas_panel_trsm as _ptrsm
+from dlaf_tpu.ops import pallas_potrf as _ppotrf
+
+
+def _axis_size(axis: str) -> int:
+    """Static mesh-axis size from inside shard_map (psum of a literal folds
+    to a Python int on every jax version; see comm.collectives.axis_size)."""
+    fn = getattr(lax, "axis_size", None)
+    return int(fn(axis)) if fn is not None else int(lax.psum(1, axis))
+
+
+def _use_dma() -> bool:
+    """The compiled remote-DMA kernel runs only on real TPU backends; every
+    other backend takes the ppermute-transport ring with the interpret-mode
+    merge kernel (same schedule, same bits)."""
+    return jax.default_backend() == "tpu"
+
+
+# --------------------------------------------------------------- flattening
+#
+# Both kernels work on a canonical 2D layout: payload (slots, w) in a real
+# dtype, have-mask (slots, 1) int32.  ``_to_wire``/``_from_wire`` map any
+# (slots, ...) payload (or a scalar-have whole-payload broadcast) onto it.
+
+
+def _to_wire(y, have):
+    slots = int(np.prod(have.shape)) if have.ndim else 1
+    yf = y.reshape(slots, -1)
+    if jnp.issubdtype(yf.dtype, jnp.complexfloating):
+        # bit-preserving reinterpret: c64 -> f32 pairs, c128 -> f64 pairs
+        yf = yf.view(jnp.float32 if yf.dtype == jnp.complex64 else jnp.float64)
+    h = have.astype(jnp.int32).reshape(slots, 1)
+    return yf, h
+
+
+def _from_wire(yf, h, y_template, have_template):
+    if jnp.issubdtype(y_template.dtype, jnp.complexfloating):
+        yf = yf.view(y_template.dtype)
+    y = yf.reshape(y_template.shape).astype(y_template.dtype)
+    have = (h != 0).reshape(have_template.shape)
+    return y, have
+
+
+# ------------------------------------------------------------- merge kernel
+
+
+def _merge_kernel(y_ref, yin_ref, h_ref, hin_ref, oy_ref, oh_ref):
+    """One ring-hop merge: take incoming bytes only for slots not yet held.
+    Pure select — contributor bytes pass through verbatim (bit-exactness
+    across tiers depends on this kernel never doing arithmetic on payload)."""
+    have = h_ref[...]
+    h_in = hin_ref[...]
+    take = jnp.logical_and(have == 0, h_in != 0)
+    oy_ref[...] = jnp.where(take, yin_ref[...], y_ref[...])
+    oh_ref[...] = have | h_in
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def merge_hop(yf, y_in, h, h_in, interpret: bool = False):
+    """The hop merge as a pallas_call on the canonical wire layout."""
+    return pl.pallas_call(
+        _merge_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(yf.shape, yf.dtype),
+            jax.ShapeDtypeStruct(h.shape, h.dtype),
+        ),
+        interpret=interpret,
+    )(yf, y_in, h, h_in)
+
+
+# ------------------------------------------------------- emulated transport
+
+
+def _ppermute_ring(yf, h, axis: str, n: int, interpret: bool):
+    """The ring schedule with lax.ppermute as the hop transport.  Used on
+    every non-TPU backend: identical merge semantics to the DMA kernel, so
+    the tier's numerical contract is CI-tested on the tier-1 CPU mesh."""
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for _ in range(n - 1):
+        y_in = lax.ppermute(yf, axis, perm)
+        h_in = lax.ppermute(h, axis, perm)
+        yf, h = merge_hop(yf, y_in, h, h_in, interpret)
+    return yf, h
+
+
+# ------------------------------------------------------------ DMA transport
+
+
+def _neighbor_ids(ring_axis: str, mesh_axes: tuple, offset: int):
+    """device_id (and its type) of the rank ``offset`` steps along the ring.
+
+    Single-axis meshes address by scalar logical index (also the only form
+    the 0.4.37 interpreter discharges); multi-axis meshes address by the
+    full mesh coordinate tuple with the ring axis advanced."""
+    n = _axis_size(ring_axis)
+    me = lax.axis_index(ring_axis)
+    step = (me + offset + n) % n  # weak-typed literals keep the index i32
+    if len(mesh_axes) == 1:
+        return step, pltpu.DeviceIdType.LOGICAL
+    coords = tuple(
+        step if a == ring_axis else lax.axis_index(a) for a in mesh_axes
+    )
+    return coords, pltpu.DeviceIdType.MESH
+
+
+def _dma_ring_kernel(
+    y_ref, h_ref, oy_ref, oh_ref, land_y, land_h,
+    send_y_sem, recv_y_sem, send_h_sem, recv_h_sem,
+    *, nhops: int, ring_axis: str, mesh_axes: tuple, barrier: bool,
+):
+    """All P-1 ring hops in one kernel launch.
+
+    ``oy_ref/oh_ref`` double as the merge accumulator (VMEM-resident for
+    the whole kernel); ``land_y/land_h`` are the two incoming landing
+    slots.  Per hop s: start the unconditional send of the accumulator to
+    the right neighbor's slot ``s%2``, wait for our own slot ``s%2`` from
+    the left, wait for the send (the accumulator must not be mutated under
+    an in-flight read), then merge.  send-before-recv-wait is the deadlock
+    ordering the skew test leans on."""
+    dst, id_type = _neighbor_ids(ring_axis, mesh_axes, +1)
+    src, _ = _neighbor_ids(ring_axis, mesh_axes, -1)
+
+    oy_ref[...] = y_ref[...]
+    oh_ref[...] = h_ref[...]
+
+    if barrier:
+        # both neighbors must have entered the kernel (buffers + semaphores
+        # live) before any remote write lands; signal each, await both
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, device_id=dst, device_id_type=id_type)
+        pltpu.semaphore_signal(bar, device_id=src, device_id_type=id_type)
+        pltpu.semaphore_wait(bar, 2)
+
+    for s in range(nhops):  # static: P-1 hops
+        slot = s % 2
+        cp_y = pltpu.make_async_remote_copy(
+            src_ref=oy_ref,
+            dst_ref=land_y.at[slot],
+            send_sem=send_y_sem.at[slot],
+            recv_sem=recv_y_sem.at[slot],
+            device_id=dst,
+            device_id_type=id_type,
+        )
+        cp_h = pltpu.make_async_remote_copy(
+            src_ref=oh_ref,
+            dst_ref=land_h.at[slot],
+            send_sem=send_h_sem.at[slot],
+            recv_sem=recv_h_sem.at[slot],
+            device_id=dst,
+            device_id_type=id_type,
+        )
+        cp_y.start()
+        cp_h.start()
+        cp_y.wait_recv()
+        cp_h.wait_recv()
+        cp_y.wait_send()
+        cp_h.wait_send()
+        have = oh_ref[...]
+        h_in = land_h[slot]
+        take = jnp.logical_and(have == 0, h_in != 0)
+        oy_ref[...] = jnp.where(take, land_y[slot], oy_ref[...])
+        oh_ref[...] = have | h_in
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def dma_ring_exchange(yf, h, ring_axis: str, mesh_axes: tuple,
+                      interpret: bool = False, collective_id: int = 0):
+    """The fused remote-DMA ring on the canonical wire layout.
+
+    ``mesh_axes`` is the full ordered axis-name tuple of the enclosing
+    shard_map mesh (device ids are mesh coordinates when it has more than
+    one axis).  ``interpret=True`` runs the identical kernel on the
+    interpreter — single-axis meshes only (the 0.4.37 discharge rule), and
+    without the entry barrier (the interpreter executes ranks in a
+    deterministic sequence; there is no rank to race)."""
+    n = _axis_size(ring_axis)
+    if n == 1:
+        return yf, h
+    scratch = [
+        pltpu.VMEM((2,) + yf.shape, yf.dtype),
+        pltpu.VMEM((2,) + h.shape, h.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    kernel = functools.partial(
+        _dma_ring_kernel,
+        nhops=n - 1,
+        ring_axis=ring_axis,
+        mesh_axes=mesh_axes,
+        barrier=not interpret,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(yf.shape, yf.dtype),
+            jax.ShapeDtypeStruct(h.shape, h.dtype),
+        ),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.TPUCompilerParams(
+            collective_id=collective_id, has_side_effects=True
+        ),
+    )(yf, h)
+
+
+# ------------------------------------------------------------- entry points
+
+
+def ring_exchange(y, have, axis: str, *, mesh_axes=("r", "c")):
+    """Forward-ring exchange of a one-contributor slotted payload.
+
+    ``have``'s shape is a leading prefix of ``y``'s (scalar for a whole-
+    payload broadcast, per-slot vector for a panel exchange); slots whose
+    ``have`` is set carry this rank's contribution.  Returns ``(y, have)``
+    after P-1 hops: every slot with any contributor on the axis holds that
+    contributor's exact bytes everywhere, slots with none keep the local
+    input (callers mask them, matching the v2 tier).  Bit-identical to
+    ``comm.collectives._forward_chain``."""
+    n = _axis_size(axis)
+    if n == 1:
+        return y, have
+    yf, h = _to_wire(y, have)
+    if _use_dma():
+        yf, h = dma_ring_exchange(yf, h, axis, tuple(mesh_axes))
+    else:
+        yf, h = _ppermute_ring(yf, h, axis, n, interpret=True)
+    return _from_wire(yf, h, y, have)
+
+
+def ring_bcast(x, is_root, axis: str, *, mesh_axes=("r", "c")):
+    """Whole-payload broadcast on the ring: the rank with ``is_root`` set
+    contributes, everyone ends with its bytes."""
+    y, _ = ring_exchange(x, is_root, axis, mesh_axes=mesh_axes)
+    return y
+
+
+# ------------------------------------------------------- fused factor+send
+
+
+def fusion_supported(d, xc) -> bool:
+    """The fused factor-and-send kernel covers the lookahead Cholesky panel
+    case: real f32/f64 tiles, MXU/VPU-aligned tile side (the composed trsm
+    kernel column-blocks by 32 and Mosaic wants lane-width multiples), and
+    a panel that is a stack of square tiles."""
+    return (
+        np.dtype(d.dtype).kind == "f"
+        and d.ndim == 2
+        and d.shape[0] == d.shape[1]
+        and xc.ndim == 3
+        and xc.shape[1:] == d.shape
+        and d.shape[0] % 128 == 0
+        and d.shape[0] <= _ptrsm.MAX_NB
+    )
+
+
+def _fused_kernel(d_ref, xc_ref, root_ref, below_ref, lkk_ref, cp_ref,
+                  u_ref, land_y, land_h, acc_h,
+                  send_y_sem, recv_y_sem, send_h_sem, recv_h_sem,
+                  *, nhops: int, ring_axis: str, mesh_axes: tuple, mb: int):
+    """potrf + panel trsm + ring send, one launch, panel never leaves VMEM.
+
+    Composes the existing kernel bodies: ``pallas_potrf._potrf_kernel``
+    factors the diagonal tile in place, ``pallas_panel_trsm._kernel``
+    solves the (ltr*mb, mb) row-flattened panel against it, and the ring
+    send of the root column's masked panel starts immediately — trailing
+    work queued behind this kernel overlaps the remaining hops."""
+    # 1. diagonal factor (identical on every rank: d was diag-broadcast)
+    _ppotrf._potrf_kernel(d_ref, lkk_ref)
+    lkk = lkk_ref[...]
+
+    # 2. op()-resolve L -> L^T once (real dtypes: conj is the identity),
+    #    then the column-blocked panel solve with the factor VMEM-resident
+    u_ref[...] = jnp.tril(lkk).T
+    _ptrsm._kernel(u_ref, xc_ref, cp_ref, nb=mb)
+
+    # 3. mask to the strictly-below-diagonal rows and ring-broadcast the
+    #    root column's panel (same merge contract as _dma_ring_kernel)
+    me = lax.axis_index(ring_axis)
+    root = root_ref[0, 0]
+    is_root = (me == root).astype(jnp.int32)
+    below = below_ref[...]  # (ltr, 1) int32: gi > k
+    rows = lax.broadcasted_iota(jnp.int32, cp_ref.shape, 0) // mb
+    keep = jnp.take(below[:, 0], rows) * is_root
+    cp_ref[...] = jnp.where(keep != 0, cp_ref[...], jnp.zeros_like(cp_ref))
+    acc_h[...] = jnp.full(acc_h.shape, is_root)
+
+    dst, id_type = _neighbor_ids(ring_axis, mesh_axes, +1)
+    src, _ = _neighbor_ids(ring_axis, mesh_axes, -1)
+    bar = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bar, device_id=dst, device_id_type=id_type)
+    pltpu.semaphore_signal(bar, device_id=src, device_id_type=id_type)
+    pltpu.semaphore_wait(bar, 2)
+
+    for s in range(nhops):
+        slot = s % 2
+        cp_y = pltpu.make_async_remote_copy(
+            src_ref=cp_ref, dst_ref=land_y.at[slot],
+            send_sem=send_y_sem.at[slot], recv_sem=recv_y_sem.at[slot],
+            device_id=dst, device_id_type=id_type,
+        )
+        cp_h = pltpu.make_async_remote_copy(
+            src_ref=acc_h, dst_ref=land_h.at[slot],
+            send_sem=send_h_sem.at[slot], recv_sem=recv_h_sem.at[slot],
+            device_id=dst, device_id_type=id_type,
+        )
+        cp_y.start()
+        cp_h.start()
+        cp_y.wait_recv()
+        cp_h.wait_recv()
+        cp_y.wait_send()
+        cp_h.wait_send()
+        have = acc_h[...]
+        h_in = land_h[slot]
+        take = jnp.logical_and(have == 0, h_in != 0)
+        cp_ref[...] = jnp.where(take, land_y[slot], cp_ref[...])
+        acc_h[...] = have | h_in
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def fused_factor_bcast(d, xc, below, root, ring_axis: str = "c",
+                       mesh_axes: tuple = ("r", "c")):
+    """Fused lookahead panel step: ``(lkk, cp)`` from the (already diag-
+    broadcast, hermitized) tile ``d`` and this rank's panel column ``xc``.
+
+    ``below[ltr]`` masks the strictly-sub-diagonal row tiles, ``root`` is
+    the (traced) owning column index along ``ring_axis``.  Equivalent to
+    ``potrf_tile(d)`` + ``panel_trsm_right_lower_t`` + ``coll.bcast`` of
+    the masked panel, with the exchange streaming on the DMA engines
+    instead of barriering.  TPU-only (``fusion_supported`` + backend gate
+    at the call site)."""
+    mb = d.shape[-1]
+    ltr = xc.shape[0]
+    n = _axis_size(ring_axis)
+    herm = jnp.tril(d) + jnp.tril(d, -1).T
+    flat = xc.reshape(ltr * mb, mb)
+    root_arr = jnp.asarray(root, jnp.int32).reshape(1, 1)
+    below_arr = below.astype(jnp.int32).reshape(ltr, 1)
+    scratch = [
+        pltpu.VMEM((mb, mb), d.dtype),                 # u = tril(L)^T
+        pltpu.VMEM((2, ltr * mb, mb), d.dtype),        # landing slots
+        pltpu.VMEM((2, 1, 1), jnp.int32),
+        pltpu.VMEM((1, 1), jnp.int32),                 # have accumulator
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    kernel = functools.partial(
+        _fused_kernel,
+        nhops=n - 1,
+        ring_axis=ring_axis,
+        mesh_axes=tuple(mesh_axes),
+        mb=mb,
+    )
+    lkk, cp = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((mb, mb), d.dtype),
+            jax.ShapeDtypeStruct((ltr * mb, mb), d.dtype),
+        ),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.TPUCompilerParams(
+            collective_id=1, has_side_effects=True
+        ),
+    )(herm, flat, root_arr, below_arr)
+    return lkk, cp.reshape(ltr, mb, mb)

@@ -109,12 +109,21 @@ class TuneParameters:
       the transpose_panel family).  'psum' = the historical reduce tier
       (masked all-reduce, ~2(P-1)/P wire bytes per device per payload);
       'v2' = gather/permute tier (doubling ppermute chain, no add-tree,
-      modeled (P-1)/P wire bytes — half the reduce tier); 'auto'
-      (default) = v2 on accelerator backends, psum on CPU until measured.
-      The knob is read at trace time; every compiled-kernel cache keys on
-      the resolved tier (collectives.collectives_trace_key), so flipping
-      it between calls retraces correctly.  True multi-contributor sums
-      (psum_axis) are reductions in every tier.
+      modeled (P-1)/P wire bytes — half the reduce tier); 'pallas' =
+      neighbor-ring Pallas kernels (ops/pallas_panel_exchange) with async
+      remote DMA on TPU — same (P-1)/P wire model, but exchanges inside a
+      collectives.overlap_window (the lookahead kernels' panel exchanges)
+      are modeled as overlapped by trailing compute; on CPU backends the
+      tier runs its ring in Pallas interpret mode (correctness path, no
+      DMA) — like the other Pallas knobs it awaits an on-hardware A/B
+      (scripts/tpu_day.sh) before any default flips; 'auto' (default) =
+      v2 on accelerator backends, psum on CPU until measured (never
+      pallas).  Values outside {psum, v2, pallas, auto} raise
+      health.ConfigurationError.  The knob is read at trace time; every
+      compiled-kernel cache keys on the resolved tier
+      (collectives.collectives_trace_key), so flipping it between calls
+      retraces correctly.  True multi-contributor sums (psum_axis) are
+      reductions in every tier.
     - ``serve_buckets``: comma-separated problem orders the serve layer
       pads requests up to (``dlaf_tpu.serve``); a request of order n runs
       at the smallest bucket >= n, sizes beyond the largest round up to a
@@ -190,8 +199,30 @@ class TuneParameters:
         for k, v in kwargs.items():
             if k not in {f.name for f in fields(self)}:
                 raise ValueError(f"unknown tune parameter {k!r}")
+            if k == "collectives_impl":
+                validate_collectives_impl(v)
             setattr(self, k, v)
         return self
+
+
+COLLECTIVES_IMPLS = ("psum", "v2", "pallas", "auto")
+
+
+def validate_collectives_impl(value) -> str:
+    """Reject values outside the documented domain with a structured error.
+
+    Called both on explicit ``update(collectives_impl=...)`` and when the
+    collectives layer resolves the knob at trace time — the latter is what
+    catches a typo'd ``DLAF_TPU_COLLECTIVES_IMPL`` env value, which would
+    otherwise surface as a confusing deep-trace failure."""
+    if value not in COLLECTIVES_IMPLS:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            f"collectives_impl must be one of {COLLECTIVES_IMPLS}, "
+            f"got {value!r} (env DLAF_TPU_COLLECTIVES_IMPL)"
+        )
+    return value
 
 
 _params: TuneParameters | None = None

@@ -93,7 +93,7 @@ def summarize(path: str) -> int:
         from dlaf_tpu.obs.comms import wire_model
 
         # aggregate across ranks/records: same key -> summed counts
-        agg = defaultdict(lambda: [0, 0, 0])
+        agg = defaultdict(lambda: [0, 0, 0, 0])
         for r in comms:
             for row in r["rows"]:
                 k = (row["collective"], row["dtype"], row["axis"], row["axis_size"])
@@ -103,20 +103,32 @@ def summarize(path: str) -> int:
                 agg[k][2] += row.get(
                     "modeled_wire_bytes", wire_model(k[0], k[3], row["bytes"])
                 )
+                # pre-overlap files: everything exposed
+                agg[k][3] += row.get("overlapped_wire_bytes", 0)
         print(f"-- comms ({len(agg)} collective classes, trace-time counts):")
-        print(f"   {'collective':18s} {'dtype':10s} {'axis':5s} "
-              f"{'P':>3s} {'msgs':>8s} {'payload':>10s} {'wire(model)':>11s}")
+        print(f"   {'collective':22s} {'dtype':10s} {'axis':5s} "
+              f"{'P':>3s} {'msgs':>8s} {'payload':>10s} {'wire(model)':>11s} "
+              f"{'overlapped':>10s}")
         total_wire = 0
+        total_overlap = 0
         saved = 0
-        for (kind, dtype, axis, p), (msgs, nbytes, wire) in sorted(agg.items()):
-            print(f"   {kind:18s} {dtype:10s} {axis or '-':5s} "
+        for (kind, dtype, axis, p), (msgs, nbytes, wire, overlap) in sorted(
+            agg.items()
+        ):
+            print(f"   {kind:22s} {dtype:10s} {axis or '-':5s} "
                   f"{p:3d} {msgs:8d} {_fmt_bytes(nbytes):>10s} "
-                  f"{_fmt_bytes(wire):>11s}")
+                  f"{_fmt_bytes(wire):>11s} "
+                  f"{_fmt_bytes(overlap) if overlap else '-':>10s}")
             total_wire += wire
-            if kind.endswith("_v2"):
-                # what the same payload would have cost on the reduce tier
-                saved += wire_model(kind[: -len("_v2")], p, nbytes) - wire
+            total_overlap += overlap
+            for suffix in ("_v2", "_pallas"):
+                if kind.endswith(suffix):
+                    # what the same payload would cost on the reduce tier
+                    saved += wire_model(kind[: -len(suffix)], p, nbytes) - wire
+                    break
         print(f"   modeled wire bytes total: {_fmt_bytes(total_wire)}"
+              f"  (exposed {_fmt_bytes(total_wire - total_overlap)}, "
+              f"overlapped {_fmt_bytes(total_overlap)})"
               + (f"  (saved {_fmt_bytes(saved)} vs reduce-tier collectives)"
                  if saved else ""))
 

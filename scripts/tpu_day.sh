@@ -84,6 +84,14 @@ done
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_suite heev_mixed \
   --m 8192 --mb 512 --type d --nruns 1 --spectrum 0:1023 --check last \
   > "$OUT/05_mixed_heev_partial.txt" 2>&1
+#    (f) collectives tiers: psum/v2/pallas three-way A/B on lookahead POTRF
+#        (watchdog-probed per tier; per-tier GFlop/s + the modeled wire
+#        split incl. the pallas overlapped column land in BENCH-shaped
+#        JSON + obs.metrics).  THE decision gate for promoting 'pallas'
+#        into the collectives 'auto' resolution.
+timeout 900 python scripts/collectives_ab.py --m 8192 --mb 512 --nruns 2 \
+  --out "$OUT/05_collectives_ab.json" --metrics "$OUT/05_collectives_ab.jsonl" \
+  > "$OUT/05_collectives_ab.log" 2>&1
 
 # 6. one profiler trace for the record
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \

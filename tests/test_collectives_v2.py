@@ -254,9 +254,20 @@ def test_trtri_psum_vs_v2(comm_grids, shape):
 def test_invalid_impl_raises(comm_grids):
     grid = _grid(comm_grids, (2, 2))
     x = np.zeros((2, 2, 1), np.float32)
-    with _impl("bogus"):
+    # fail-fast: explicit update() rejects the typo before anything traces
+    with pytest.raises(ValueError, match="collectives_impl"):
+        with _impl("bogus"):
+            pass  # pragma: no cover - update raises on context entry
+    # values that bypass update() (an env-injected typo) still raise at
+    # trace time, when the collectives layer resolves the knob
+    tp = tune.get_tune_parameters()
+    old = tp.collectives_impl
+    tp.collectives_impl = "bogus"  # direct set: the env-read path's shape
+    try:
         with pytest.raises(ValueError, match="collectives_impl"):
             _run(grid, lambda v: coll.bcast(v, 0, COL_AXIS), x)
+    finally:
+        tp.collectives_impl = old
 
 
 def test_auto_resolves_psum_on_cpu():

@@ -66,7 +66,8 @@ def test_metrics_schema_roundtrip(tmp_path):
     rows = recs[5]["rows"]
     assert rows == [
         {"collective": "psum", "dtype": "float32", "axis": "",
-         "axis_size": 0, "messages": 1, "bytes": 64, "modeled_wire_bytes": 0}
+         "axis_size": 0, "messages": 1, "bytes": 64, "modeled_wire_bytes": 0,
+         "overlapped_wire_bytes": 0}
     ]
 
 
@@ -117,8 +118,8 @@ def test_comms_byte_math(grid_2x4, impl, bkind, bwire_of):
         out.block_until_ready()
     acc = ocomms.stop()
     assert acc == {
-        ("psum", "float32", COL_AXIS, 4): [1, nloc * 4, round(1.5 * nloc * 4)],
-        (bkind, "float32", ROW_AXIS, 2): [1, nloc * 4, bwire_of(nloc * 4)],
+        ("psum", "float32", COL_AXIS, 4): [1, nloc * 4, round(1.5 * nloc * 4), 0],
+        (bkind, "float32", ROW_AXIS, 2): [1, nloc * 4, bwire_of(nloc * 4), 0],
     }
     rows = ocomms.as_records(acc)
     assert {r["collective"] for r in rows} == {"psum", bkind}
@@ -130,11 +131,60 @@ def test_comms_byte_math(grid_2x4, impl, bkind, bwire_of):
 def test_comms_legacy_two_element_rows():
     """as_records must keep accepting pre-wire-model accumulators (older
     pickled/forwarded dicts carry [messages, bytes] only): the modeled
-    column is recomputed from the wire model on the fly."""
+    column is recomputed from the wire model on the fly and the overlapped
+    column defaults to zero (everything exposed)."""
     acc = {("psum", "float32", COL_AXIS, 4): [2, 128]}
     (row,) = ocomms.as_records(acc)
     assert row["messages"] == 2 and row["bytes"] == 128
     assert row["modeled_wire_bytes"] == ocomms.wire_model("psum", 4, 128)
+    assert row["overlapped_wire_bytes"] == 0
+    # pre-overlap 3-element accumulators likewise
+    acc3 = {("bcast_v2", "float32", COL_AXIS, 4): [1, 64, 48]}
+    (row3,) = ocomms.as_records(acc3)
+    assert row3["modeled_wire_bytes"] == 48
+    assert row3["overlapped_wire_bytes"] == 0
+
+
+def test_comms_overlapped_column_accumulates(grid_2x4):
+    """A pallas-tier collective traced inside collectives.overlap_window
+    lands its modeled wire bytes in the overlapped column too; the same
+    collective outside a window stays fully exposed."""
+    mat = DistributedMatrix.zeros(grid_2x4, (16, 16), (4, 4), np.float32)
+    nloc = int(np.prod(mat.data.shape[2:]))
+
+    def fn(x):
+        y = coll.local(x)
+        with coll.overlap_window():
+            y = coll.bcast(y, 0, COL_AXIS)  # overlapped
+        y = coll.bcast(y, 0, ROW_AXIS)      # exposed
+        return coll.relocal(y)
+
+    ocomms.start()
+    with _collectives_impl("pallas"):
+        out = coll.spmd(grid_2x4, fn)(mat.data)
+        out.block_until_ready()
+    acc = ocomms.stop()
+    w4 = ocomms.wire_model("bcast_pallas", 4, nloc * 4)
+    w2 = ocomms.wire_model("bcast_pallas", 2, nloc * 4)
+    assert acc == {
+        ("bcast_pallas", "float32", COL_AXIS, 4): [1, nloc * 4, w4, w4],
+        ("bcast_pallas", "float32", ROW_AXIS, 2): [1, nloc * 4, w2, 0],
+    }
+    rows = {r["axis"]: r for r in ocomms.as_records(acc)}
+    assert rows[COL_AXIS]["overlapped_wire_bytes"] == w4
+    assert rows[ROW_AXIS]["overlapped_wire_bytes"] == 0
+
+
+def test_wire_model_pallas_matches_v2_ring():
+    """The pallas tier moves the SAME (P-1)/P ring volume as v2 — the win
+    is classification (overlap), not fewer bytes."""
+    for p in (2, 4, 8):
+        for nbytes in (64, 1000):
+            assert ocomms.wire_model("bcast_pallas", p, nbytes) == \
+                ocomms.wire_model("bcast_v2", p, nbytes)
+            assert ocomms.wire_model("transpose_panel_pallas", p, nbytes) == \
+                ocomms.wire_model("transpose_panel_v2", p, nbytes)
+    assert ocomms.wire_model("bcast_pallas", 1, 4096) == 0
 
 
 def test_wire_model_v2_halves_reduce_tier():
