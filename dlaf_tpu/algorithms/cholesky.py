@@ -61,6 +61,28 @@ def _diag_potrf(d):
     return t.potrf(d, lower=True)
 
 
+_fused_decline_warned = False
+
+
+def _warn_fused_decline(reason: str) -> None:
+    """One-time visible signal that the fused pallas path disengaged for a
+    reason other than the static gates — without it the tier could quietly
+    never engage and an A/B would measure nothing."""
+    global _fused_decline_warned
+    if _fused_decline_warned:
+        return
+    _fused_decline_warned = True
+    import warnings
+
+    warnings.warn(
+        f"pallas fused factor+bcast declined ({reason}); lookahead panels "
+        "take the unfused pallas path (same math, exchange not fused under "
+        "the factor)",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _fused_panel_bcast(d, xc, below, root, overlap: bool):
     """Fused factor-and-send for the lookahead panel: one Pallas kernel
     composing the potrf sweep, the column-blocked panel trsm, and the
@@ -68,22 +90,34 @@ def _fused_panel_bcast(d, xc, below, root, overlap: bool):
     so the panel starts streaming the moment it is factored.  Engages only
     under the pallas collectives tier on a real TPU backend (the exchange
     needs ICI DMA); returns None to take the unfused path otherwise —
-    identical math either way."""
+    identical math either way.
+
+    Only the narrow kernel-unavailable declines (ImportError /
+    NotImplementedError) fall back, and they warn once; any other
+    trace-time failure propagates — a blanket fallback here would silently
+    disengage the fused tier with no signal why.  A bad
+    ``collectives_impl`` value raises ``ConfigurationError`` from the
+    trace-key resolution, as everywhere else."""
+    if (
+        coll.collectives_trace_key() != "pallas"
+        or jax.default_backend() != "tpu"
+        or coll.axis_size(COL_AXIS) <= 1
+    ):
+        return None
     try:
         from dlaf_tpu.ops import pallas_panel_exchange as ppe
-
-        if (
-            coll.collectives_trace_key() == "pallas"
-            and jax.default_backend() == "tpu"
-            and coll.axis_size(COL_AXIS) > 1
-            and ppe.fusion_supported(d, xc)
-        ):
-            lkk, cp = ppe.fused_factor_bcast(d, xc, below, root, COL_AXIS)
-            _rec_comms("bcast_pallas", xc, COL_AXIS, overlapped=overlap)
-            return lkk, cp
-    except Exception:
-        pass
-    return None
+    except ImportError as e:
+        _warn_fused_decline(repr(e))
+        return None
+    if not ppe.fusion_supported(d, xc):
+        return None
+    try:
+        lkk, cp = ppe.fused_factor_bcast(d, xc, below, root, COL_AXIS)
+    except NotImplementedError as e:
+        _warn_fused_decline(repr(e))
+        return None
+    _rec_comms("bcast_pallas", xc, COL_AXIS, overlapped=overlap)
+    return lkk, cp
 
 
 def _pivot_scan(d):

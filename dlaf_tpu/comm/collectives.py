@@ -62,6 +62,7 @@ collective ops at all (and report nothing — there is no traffic).
 from __future__ import annotations
 
 import contextlib
+import contextvars
 
 import jax
 import jax.numpy as jnp
@@ -120,7 +121,9 @@ def collectives_trace_key() -> str:
 
 # ------------------------------------------------------------ overlap scope
 
-_overlap_depth = 0
+_overlap_depth = contextvars.ContextVar(
+    "dlaf_tpu_collectives_overlap_depth", default=0
+)
 
 
 @contextlib.contextmanager
@@ -134,18 +137,22 @@ def overlap_window():
     MXU runs, so its records inside a window count as *overlapped*; the
     psum/v2 tiers lower to XLA collectives that barrier regardless, so
     their bytes stay *exposed* even here.  That split is the modeled win
-    ``scripts/report_metrics.py`` prints and the tpu_day A/B measures."""
-    global _overlap_depth
-    _overlap_depth += 1
+    ``scripts/report_metrics.py`` prints and the tpu_day A/B measures.
+
+    The nesting depth is a ``contextvars.ContextVar`` — per-thread and
+    per-async-task — because windows are entered at trace time and
+    ``dlaf_tpu.serve`` traces on an async pool: a window open on one
+    worker must not classify a concurrent trace's records as overlapped."""
+    token = _overlap_depth.set(_overlap_depth.get() + 1)
     try:
         yield
     finally:
-        _overlap_depth -= 1
+        _overlap_depth.reset(token)
 
 
 def _rec_tier(kind: str, x, axis: str) -> None:
     """Record a pallas-tier collective, overlapped iff inside a window."""
-    _rec(kind, x, axis, overlapped=_overlap_depth > 0)
+    _rec(kind, x, axis, overlapped=_overlap_depth.get() > 0)
 
 
 def _forward_chain(y, have, axis: str):

@@ -40,21 +40,34 @@ Execution paths
 ``ring_exchange`` picks per backend at trace time:
 
 * **TPU**: one fused ``pallas_call`` (``_dma_ring_kernel``) running all
-  ``P-1`` hops with double-buffered VMEM landing slots and per-slot DMA
-  send/recv semaphores.  Deadlock freedom: every rank starts its
-  (unconditional) send *before* waiting on its recv semaphore, so a rank
-  delayed by skew stalls its neighbors at the semaphore wait — never a
-  cycle.  Two landing slots suffice because a neighbor can run at most one
-  hop ahead: its hop ``s+2`` send into slot ``s%2`` is ordered after it
-  received our hop ``s+1`` payload, which we send only after consuming
-  slot ``s%2``.
+  ``P-1`` hops with double-buffered VMEM landing slots, per-slot DMA
+  send/recv semaphores, and per-slot capacity (ack) semaphores for
+  backpressure.  The ring is unidirectional: a rank's landing slots are
+  written by its *upstream* neighbor, while its own sends gate only the
+  downstream side — ordering propagates only the long way around the
+  ring, so without an explicit ack an upstream rank could run up to
+  ``P-1`` hops ahead and its hop-``s+2`` copy could overwrite landing
+  slot ``s%2`` before a skewed rank merged hop ``s``.  The protocol
+  (``_ring_hops``): after merging hop ``s`` the receiver signals the
+  writer's capacity semaphore for that slot, and the writer waits on it
+  before reusing the slot at hop ``s+2``; the first two hops need no
+  wait, and an ack is only sent when the writer will actually reuse the
+  slot, so every semaphore drains to zero at kernel exit.  Deadlock
+  freedom: every rank starts its hop-``s`` send before waiting on its
+  own recv, and every wait is on an event strictly earlier in the global
+  hop order (recv waits on the upstream hop-``s`` send, capacity waits
+  on the downstream hop-``s-2`` merge), so a delayed rank stalls its
+  neighbors at a semaphore — never a cycle.
 * **CPU / interpret (the tier-1 mesh)**: the identical ring schedule with
   the hop transport as ``lax.ppermute`` and the per-hop merge as a Pallas
   kernel in interpret mode — the jax-0.4.37 interpreter only discharges
   remote DMA over a single named mesh axis, so on the 2D ('r','c') grid
   the kernel under test is the merge, and the remote-copy kernel itself is
   exercised by the single-axis interpret tests in
-  ``tests/test_collectives_pallas.py``.  Interpret-mode constraint: Pallas
+  ``tests/test_collectives_pallas.py`` (entry barrier and capacity acks
+  off there: the interpreter executes ranks in a deterministic sequence,
+  so there is no rank to race and no remote signal to discharge).
+  Interpret-mode constraint: Pallas
   outputs must be numeric (bool outputs crash the 0.4.37 interpreter), so
   ``have`` masks travel as int32 and complex payloads travel as
   bit-preserving float pair views (``.view()`` roundtrips exactly).
@@ -94,6 +107,52 @@ def _use_dma() -> bool:
     other backend takes the ppermute-transport ring with the interpret-mode
     merge kernel (same schedule, same bits)."""
     return jax.default_backend() == "tpu"
+
+
+# ----------------------------------------------------------- collective ids
+#
+# Mosaic kernels with the same ``collective_id`` share barrier-semaphore
+# state and must NEVER be live on a device concurrently.  This tier exists
+# precisely so its DMA kernels can drain while later work (including other
+# ``has_side_effects`` kernels not data-dependent on them) runs, so any two
+# kernels the scheduler could overlap need distinct ids.  Allocation: one
+# id per (entry-point kind, mesh axis) call-site class —
+#
+#   1     ``fused_factor_bcast`` (lookahead panel factor+send)
+#   2, 3  ``ring_bcast`` along 'r' / 'c'
+#   4, 5  ``ring_exchange`` (the ``transpose_panel*`` family) along 'r'/'c'
+#   8+    any other (kind, axis) pair, allocated on first use
+#
+# Residual invariant (documented, not machine-checkable here): two kernels
+# of the SAME class must be ordered by data dependence.  Every call site in
+# ``comm.collectives`` satisfies this today — each panel step's exchange
+# consumes the previous step's output through the loop carry, and within a
+# step the bcast -> transpose chain is data-dependent.  A caller issuing
+# two genuinely independent same-class exchanges in one program must pass
+# distinct ids to ``dma_ring_exchange`` explicitly.
+
+FUSED_COLLECTIVE_ID = 1
+_RESERVED_COLLECTIVE_IDS = {
+    ("bcast", "r"): 2,
+    ("bcast", "c"): 3,
+    ("exchange", "r"): 4,
+    ("exchange", "c"): 5,
+}
+_dynamic_collective_ids: dict = {}
+
+
+def collective_id_for(kind: str, axis: str) -> int:
+    """Stable ``collective_id`` for a (kind, axis) call-site class (table
+    above).  Deterministic across ranks: reserved pairs come from the
+    static table, and first-use allocation for any other pair follows the
+    identical trace order on every rank of an SPMD program."""
+    key = (kind, axis)
+    cid = _RESERVED_COLLECTIVE_IDS.get(key)
+    if cid is None:
+        cid = _dynamic_collective_ids.setdefault(
+            key, 8 + len(_dynamic_collective_ids)
+        )
+    return cid
 
 
 # --------------------------------------------------------------- flattening
@@ -183,38 +242,43 @@ def _neighbor_ids(ring_axis: str, mesh_axes: tuple, offset: int):
     return coords, pltpu.DeviceIdType.MESH
 
 
-def _dma_ring_kernel(
-    y_ref, h_ref, oy_ref, oh_ref, land_y, land_h,
-    send_y_sem, recv_y_sem, send_h_sem, recv_h_sem,
-    *, nhops: int, ring_axis: str, mesh_axes: tuple, barrier: bool,
+def _ring_hops(
+    acc_y, acc_h, land_y, land_h,
+    send_y_sem, recv_y_sem, send_h_sem, recv_h_sem, cap_sem,
+    *, nhops: int, dst, src, id_type, backpressure: bool,
 ):
-    """All P-1 ring hops in one kernel launch.
+    """The shared P-1-hop ring loop (both DMA kernels run exactly this).
 
-    ``oy_ref/oh_ref`` double as the merge accumulator (VMEM-resident for
-    the whole kernel); ``land_y/land_h`` are the two incoming landing
-    slots.  Per hop s: start the unconditional send of the accumulator to
-    the right neighbor's slot ``s%2``, wait for our own slot ``s%2`` from
-    the left, wait for the send (the accumulator must not be mutated under
-    an in-flight read), then merge.  send-before-recv-wait is the deadlock
-    ordering the skew test leans on."""
-    dst, id_type = _neighbor_ids(ring_axis, mesh_axes, +1)
-    src, _ = _neighbor_ids(ring_axis, mesh_axes, -1)
+    ``acc_y/acc_h`` are the VMEM-resident merge accumulators, ``land_y/
+    land_h`` the two incoming landing slots.  Per hop s: wait (hops >= 2)
+    for the downstream neighbor's capacity ack on slot ``s%2``, start the
+    unconditional send of the accumulator pair into the neighbor's slot
+    ``s%2``, wait for our own slot ``s%2`` from upstream, wait for the
+    send (the accumulator must not be mutated under an in-flight read),
+    merge, then ack the upstream writer if it will reuse the slot.
 
-    oy_ref[...] = y_ref[...]
-    oh_ref[...] = h_ref[...]
+    The capacity semaphore is the backpressure that makes TWO landing
+    slots safe at any ring size: without it, ordering propagates only the
+    long way around the unidirectional ring, so an upstream rank could
+    run up to P-1 hops ahead of a skewed rank and overwrite slot ``s%2``
+    with its hop-``s+2`` copy before hop ``s`` was merged.  Wait/signal
+    pairing is exact — the writer waits at hops ``2..nhops-1``, the
+    receiver signals at hops ``0..nhops-3`` — so the semaphores drain to
+    zero at kernel exit.  send-before-recv-wait is the deadlock ordering
+    the skew test leans on; the capacity wait precedes the send and
+    depends only on the downstream hop-``s-2`` merge, an event strictly
+    earlier in the global hop order, so it cannot close a cycle either.
 
-    if barrier:
-        # both neighbors must have entered the kernel (buffers + semaphores
-        # live) before any remote write lands; signal each, await both
-        bar = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(bar, device_id=dst, device_id_type=id_type)
-        pltpu.semaphore_signal(bar, device_id=src, device_id_type=id_type)
-        pltpu.semaphore_wait(bar, 2)
-
+    ``backpressure=False`` is for the interpreter only (ranks execute
+    sequentially; remote semaphore signals are not discharged there)."""
     for s in range(nhops):  # static: P-1 hops
         slot = s % 2
+        if backpressure and s >= 2:
+            # downstream neighbor must have merged our hop s-2 copy out of
+            # this landing slot before we overwrite it with hop s
+            pltpu.semaphore_wait(cap_sem.at[slot], 1)
         cp_y = pltpu.make_async_remote_copy(
-            src_ref=oy_ref,
+            src_ref=acc_y,
             dst_ref=land_y.at[slot],
             send_sem=send_y_sem.at[slot],
             recv_sem=recv_y_sem.at[slot],
@@ -222,7 +286,7 @@ def _dma_ring_kernel(
             device_id_type=id_type,
         )
         cp_h = pltpu.make_async_remote_copy(
-            src_ref=oh_ref,
+            src_ref=acc_h,
             dst_ref=land_h.at[slot],
             send_sem=send_h_sem.at[slot],
             recv_sem=recv_h_sem.at[slot],
@@ -235,11 +299,47 @@ def _dma_ring_kernel(
         cp_h.wait_recv()
         cp_y.wait_send()
         cp_h.wait_send()
-        have = oh_ref[...]
+        have = acc_h[...]
         h_in = land_h[slot]
         take = jnp.logical_and(have == 0, h_in != 0)
-        oy_ref[...] = jnp.where(take, land_y[slot], oy_ref[...])
-        oh_ref[...] = have | h_in
+        acc_y[...] = jnp.where(take, land_y[slot], acc_y[...])
+        acc_h[...] = have | h_in
+        if backpressure and s + 2 < nhops:
+            # slot consumed: the upstream writer may reuse it at hop s+2
+            pltpu.semaphore_signal(
+                cap_sem.at[slot], device_id=src, device_id_type=id_type
+            )
+
+
+def _dma_ring_kernel(
+    y_ref, h_ref, oy_ref, oh_ref, land_y, land_h,
+    send_y_sem, recv_y_sem, send_h_sem, recv_h_sem, cap_sem,
+    *, nhops: int, ring_axis: str, mesh_axes: tuple, sync: bool,
+):
+    """All P-1 ring hops in one kernel launch (see ``_ring_hops`` for the
+    hop protocol).  ``oy_ref/oh_ref`` double as the merge accumulator,
+    VMEM-resident for the whole kernel.  ``sync`` gates the cross-rank
+    synchronization (entry barrier + capacity acks): on for the compiled
+    TPU path, off under the interpreter."""
+    dst, id_type = _neighbor_ids(ring_axis, mesh_axes, +1)
+    src, _ = _neighbor_ids(ring_axis, mesh_axes, -1)
+
+    oy_ref[...] = y_ref[...]
+    oh_ref[...] = h_ref[...]
+
+    if sync:
+        # both neighbors must have entered the kernel (buffers + semaphores
+        # live) before any remote write lands; signal each, await both
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, device_id=dst, device_id_type=id_type)
+        pltpu.semaphore_signal(bar, device_id=src, device_id_type=id_type)
+        pltpu.semaphore_wait(bar, 2)
+
+    _ring_hops(
+        oy_ref, oh_ref, land_y, land_h,
+        send_y_sem, recv_y_sem, send_h_sem, recv_h_sem, cap_sem,
+        nhops=nhops, dst=dst, src=src, id_type=id_type, backpressure=sync,
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
@@ -251,8 +351,13 @@ def dma_ring_exchange(yf, h, ring_axis: str, mesh_axes: tuple,
     shard_map mesh (device ids are mesh coordinates when it has more than
     one axis).  ``interpret=True`` runs the identical kernel on the
     interpreter — single-axis meshes only (the 0.4.37 discharge rule), and
-    without the entry barrier (the interpreter executes ranks in a
-    deterministic sequence; there is no rank to race)."""
+    without the entry barrier or capacity acks (the interpreter executes
+    ranks in a deterministic sequence; there is no rank to race).
+
+    ``collective_id`` MUST be distinct for any two kernels that could be
+    live concurrently (they share barrier-semaphore state) — callers go
+    through :func:`collective_id_for` per (entry-point, axis) class; see
+    the allocation table above."""
     n = _axis_size(ring_axis)
     if n == 1:
         return yf, h
@@ -263,13 +368,14 @@ def dma_ring_exchange(yf, h, ring_axis: str, mesh_axes: tuple,
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),  # per-slot capacity acks
     ]
     kernel = functools.partial(
         _dma_ring_kernel,
         nhops=n - 1,
         ring_axis=ring_axis,
         mesh_axes=mesh_axes,
-        barrier=not interpret,
+        sync=not interpret,
     )
     return pl.pallas_call(
         kernel,
@@ -288,7 +394,8 @@ def dma_ring_exchange(yf, h, ring_axis: str, mesh_axes: tuple,
 # ------------------------------------------------------------- entry points
 
 
-def ring_exchange(y, have, axis: str, *, mesh_axes=("r", "c")):
+def ring_exchange(y, have, axis: str, *, mesh_axes=("r", "c"),
+                  kind: str = "exchange"):
     """Forward-ring exchange of a one-contributor slotted payload.
 
     ``have``'s shape is a leading prefix of ``y``'s (scalar for a whole-
@@ -297,13 +404,19 @@ def ring_exchange(y, have, axis: str, *, mesh_axes=("r", "c")):
     after P-1 hops: every slot with any contributor on the axis holds that
     contributor's exact bytes everywhere, slots with none keep the local
     input (callers mask them, matching the v2 tier).  Bit-identical to
-    ``comm.collectives._forward_chain``."""
+    ``comm.collectives._forward_chain``.
+
+    ``kind`` names the call-site class for the collective-id allocation
+    (``collective_id_for(kind, axis)``) — distinct classes may be live
+    concurrently, same-class calls must be chained by data dependence."""
     n = _axis_size(axis)
     if n == 1:
         return y, have
     yf, h = _to_wire(y, have)
     if _use_dma():
-        yf, h = dma_ring_exchange(yf, h, axis, tuple(mesh_axes))
+        yf, h = dma_ring_exchange(
+            yf, h, axis, tuple(mesh_axes), False, collective_id_for(kind, axis)
+        )
     else:
         yf, h = _ppermute_ring(yf, h, axis, n, interpret=True)
     return _from_wire(yf, h, y, have)
@@ -312,7 +425,7 @@ def ring_exchange(y, have, axis: str, *, mesh_axes=("r", "c")):
 def ring_bcast(x, is_root, axis: str, *, mesh_axes=("r", "c")):
     """Whole-payload broadcast on the ring: the rank with ``is_root`` set
     contributes, everyone ends with its bytes."""
-    y, _ = ring_exchange(x, is_root, axis, mesh_axes=mesh_axes)
+    y, _ = ring_exchange(x, is_root, axis, mesh_axes=mesh_axes, kind="bcast")
     return y
 
 
@@ -337,7 +450,7 @@ def fusion_supported(d, xc) -> bool:
 
 def _fused_kernel(d_ref, xc_ref, root_ref, below_ref, lkk_ref, cp_ref,
                   u_ref, land_y, land_h, acc_h,
-                  send_y_sem, recv_y_sem, send_h_sem, recv_h_sem,
+                  send_y_sem, recv_y_sem, send_h_sem, recv_h_sem, cap_sem,
                   *, nhops: int, ring_axis: str, mesh_axes: tuple, mb: int):
     """potrf + panel trsm + ring send, one launch, panel never leaves VMEM.
 
@@ -373,29 +486,11 @@ def _fused_kernel(d_ref, xc_ref, root_ref, below_ref, lkk_ref, cp_ref,
     pltpu.semaphore_signal(bar, device_id=src, device_id_type=id_type)
     pltpu.semaphore_wait(bar, 2)
 
-    for s in range(nhops):
-        slot = s % 2
-        cp_y = pltpu.make_async_remote_copy(
-            src_ref=cp_ref, dst_ref=land_y.at[slot],
-            send_sem=send_y_sem.at[slot], recv_sem=recv_y_sem.at[slot],
-            device_id=dst, device_id_type=id_type,
-        )
-        cp_h = pltpu.make_async_remote_copy(
-            src_ref=acc_h, dst_ref=land_h.at[slot],
-            send_sem=send_h_sem.at[slot], recv_sem=recv_h_sem.at[slot],
-            device_id=dst, device_id_type=id_type,
-        )
-        cp_y.start()
-        cp_h.start()
-        cp_y.wait_recv()
-        cp_h.wait_recv()
-        cp_y.wait_send()
-        cp_h.wait_send()
-        have = acc_h[...]
-        h_in = land_h[slot]
-        take = jnp.logical_and(have == 0, h_in != 0)
-        cp_ref[...] = jnp.where(take, land_y[slot], cp_ref[...])
-        acc_h[...] = have | h_in
+    _ring_hops(
+        cp_ref, acc_h, land_y, land_h,
+        send_y_sem, recv_y_sem, send_h_sem, recv_h_sem, cap_sem,
+        nhops=nhops, dst=dst, src=src, id_type=id_type, backpressure=True,
+    )
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
@@ -426,6 +521,7 @@ def fused_factor_bcast(d, xc, below, root, ring_axis: str = "c",
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),     # per-slot capacity acks
     ]
     kernel = functools.partial(
         _fused_kernel,
@@ -442,7 +538,7 @@ def fused_factor_bcast(d, xc, below, root, ring_axis: str = "c",
         ),
         scratch_shapes=scratch,
         compiler_params=pltpu.TPUCompilerParams(
-            collective_id=1, has_side_effects=True
+            collective_id=FUSED_COLLECTIVE_ID, has_side_effects=True
         ),
     )(herm, flat, root_arr, below_arr)
     return lkk, cp.reshape(ltr, mb, mb)

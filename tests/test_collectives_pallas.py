@@ -404,6 +404,86 @@ def test_psum_v2_never_overlapped(grid_2x4):
                    for r in ocomms.as_records(acc)), tier
 
 
+# ------------------------------------------------- concurrency / fallback
+
+
+def test_collective_ids_distinct_and_stable():
+    """Kernels sharing a collective_id share barrier-semaphore state and
+    must never be live concurrently; every call-site class the scheduler
+    could overlap (the whole point of the tier) gets a distinct id."""
+    classes = [(k, a) for k in ("bcast", "exchange") for a in ("r", "c")]
+    ids = [ppe.collective_id_for(k, a) for k, a in classes]
+    ids.append(ppe.FUSED_COLLECTIVE_ID)
+    assert len(set(ids)) == len(ids)
+    # stable across calls (same trace order on every SPMD rank)
+    for k, a in classes:
+        assert ppe.collective_id_for(k, a) == ppe.collective_id_for(k, a)
+    # unknown classes allocate deterministically on first use, off the
+    # reserved range
+    extra = ppe.collective_id_for("exchange", "b")
+    assert extra == ppe.collective_id_for("exchange", "b")
+    assert extra not in ids
+
+
+def test_overlap_window_thread_isolated():
+    """The window depth is a ContextVar: dlaf_tpu.serve traces on an async
+    pool, so a window open on one thread must not classify a concurrent
+    trace's records as overlapped."""
+    import threading
+
+    seen = {}
+
+    def probe():
+        seen["other_thread"] = coll._overlap_depth.get()
+
+    with coll.overlap_window():
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        seen["inside"] = coll._overlap_depth.get()
+    seen["after"] = coll._overlap_depth.get()
+    assert seen == {"other_thread": 0, "inside": 1, "after": 0}
+
+
+def test_fused_panel_bcast_decline_and_propagate(monkeypatch):
+    """_fused_panel_bcast falls back (with a one-time warning) only on the
+    narrow kernel-unavailable declines; real trace-time bugs propagate
+    instead of silently disengaging the fused tier."""
+    import warnings
+
+    from dlaf_tpu.algorithms import cholesky as ch
+
+    d = np.eye(128, dtype=np.float32)
+    xc = np.zeros((1, 128, 128), np.float32)
+    below = np.ones((1,), bool)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(coll, "axis_size", lambda axis: 2)
+    monkeypatch.setattr(ch, "_fused_decline_warned", False)
+
+    def raise_(e):
+        def fn(*a, **k):
+            raise e
+
+        return fn
+
+    with _impl("pallas"):
+        monkeypatch.setattr(
+            ppe, "fused_factor_bcast", raise_(NotImplementedError("no mosaic"))
+        )
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert ch._fused_panel_bcast(d, xc, below, 0, False) is None
+        assert any("declined" in str(w.message) for w in rec)
+        monkeypatch.setattr(ppe, "fused_factor_bcast", raise_(RuntimeError("boom")))
+        with pytest.raises(RuntimeError, match="boom"):
+            ch._fused_panel_bcast(d, xc, below, 0, False)
+    # off-tier: static gate declines before touching the kernel, no warning
+    with _impl("v2"), warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert ch._fused_panel_bcast(d, xc, below, 0, False) is None
+    assert not rec
+
+
 # ------------------------------------------------------ validation / policy
 
 
