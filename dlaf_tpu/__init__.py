@@ -21,6 +21,7 @@ from dlaf_tpu.health import (
     NonFiniteError,
     NotPositiveDefiniteError,
     QueueFullError,
+    TenantQuotaExceededError,
 )
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
@@ -80,6 +81,7 @@ __all__ = [
     "DeadlineExceededError",
     "DeviceUnresponsiveError",
     "QueueFullError",
+    "TenantQuotaExceededError",
     "Distribution",
     "DistributedMatrix",
     "MatrixRef",

@@ -133,6 +133,26 @@ class QueueFullError(DlafError, RuntimeError):
         )
 
 
+class TenantQuotaExceededError(QueueFullError):
+    """The serve gateway shed a request at admission because the tenant's
+    token-bucket quota was exhausted (``serve.TenantConfig.rate`` /
+    ``burst``).  Subclasses :class:`QueueFullError` so generic
+    shed-and-retry handlers keep working; ``tenant`` names the offender
+    and ``rate`` its configured refill rate in requests/second."""
+
+    def __init__(self, tenant: str, rate: float, message: str | None = None):
+        self.tenant = str(tenant)
+        self.rate = float(rate)
+        super().__init__(
+            0, 0,
+            message
+            or (
+                f"tenant {self.tenant!r} exceeded its request quota "
+                f"(token bucket empty at rate {self.rate:g}/s); retry later"
+            ),
+        )
+
+
 class DeviceUnresponsiveError(DlafError, RuntimeError):
     """The device watchdog's bounded liveness probe was exhausted: the
     device did not answer a tiny pre-compiled kernel within ``budget_s``

@@ -14,6 +14,13 @@ Three pieces (see each module's docstring):
   futures front door: queueing, request fusion, deadlines
   (``resilience``), :class:`~dlaf_tpu.health.QueueFullError`
   backpressure.
+* :mod:`~dlaf_tpu.serve.gateway` / :mod:`~dlaf_tpu.serve.qos` /
+  :mod:`~dlaf_tpu.serve.router` — the v2 multi-tenant front door:
+  :class:`~dlaf_tpu.serve.gateway.Gateway` continuous batching with
+  per-tenant QoS (:class:`~dlaf_tpu.serve.qos.TenantConfig` token
+  buckets, weighted-fair lanes, deadline-aware eviction) routed across
+  replicas (:class:`~dlaf_tpu.serve.router.Router` watchdog probes and
+  drain-to-sibling failover).
 """
 from dlaf_tpu.serve.batched import (
     batched_cholesky_factorization,
@@ -27,18 +34,28 @@ from dlaf_tpu.serve.bucketing import (
     default_cache,
 )
 from dlaf_tpu.serve.context import serve_trace_key, serving
-from dlaf_tpu.serve.pool import ServeResult, SolverPool
+from dlaf_tpu.serve.gateway import Gateway
+from dlaf_tpu.serve.pool import ServeResult, SolverPool, make_request
+from dlaf_tpu.serve.qos import FairQueue, TenantConfig, TokenBucket
+from dlaf_tpu.serve.router import Replica, Router
 
 __all__ = [
     "CompiledCache",
+    "FairQueue",
+    "Gateway",
+    "Replica",
+    "Router",
     "ServeResult",
     "SolverPool",
+    "TenantConfig",
+    "TokenBucket",
     "batched_cholesky_factorization",
     "batched_eigensolver",
     "batched_positive_definite_solver",
     "bucket_for",
     "bucket_table",
     "default_cache",
+    "make_request",
     "serve_trace_key",
     "serving",
 ]

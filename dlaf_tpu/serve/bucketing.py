@@ -23,6 +23,7 @@ request).  Two pieces:
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import OrderedDict
 
@@ -65,6 +66,25 @@ def bucket_label(key) -> str:
     return "/".join(str(p) for p in key) if isinstance(key, tuple) else str(key)
 
 
+def key_labels(key) -> dict:
+    """Structured labels extracted from an executable key for metrics.
+
+    The batched drivers key executables as ``(op, n, dtype, ...)`` — pull
+    those three out as separate fields so ``report_metrics.py`` can
+    attribute cache hits/misses/evictions (churn) to specific buckets
+    instead of one opaque joined string.  Foreign key shapes degrade to no
+    labels rather than guessing."""
+    out: dict = {}
+    if isinstance(key, tuple) and len(key) >= 3:
+        if isinstance(key[0], str):
+            out["op"] = key[0]
+        if isinstance(key[1], int) and not isinstance(key[1], bool):
+            out["n"] = key[1]
+        if isinstance(key[2], str):
+            out["dtype"] = key[2]
+    return out
+
+
 class CompiledCache:
     """Bounded LRU of compiled executables, eviction-counted.
 
@@ -86,6 +106,9 @@ class CompiledCache:
             raise DistributionError(f"serve cache capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._entries: OrderedDict = OrderedDict()
+        # several pool workers may share one cache (multi-replica routing);
+        # builds run OUTSIDE the lock so a slow compile never blocks a hit
+        self._lock = threading.Lock()
         self.counters = {"hit": 0, "miss": 0, "evict": 0}
 
     def __len__(self) -> int:
@@ -99,25 +122,38 @@ class CompiledCache:
         return self.counters["hit"] / tot if tot else 0.0
 
     def get(self, key, builder):
-        if key in self._entries:
-            self.counters["hit"] += 1
-            self._entries.move_to_end(key)
-            om.emit("serve", event="cache_hit", bucket=bucket_label(key))
-            return self._entries[key]
-        self.counters["miss"] += 1
-        om.emit("serve", event="cache_miss", bucket=bucket_label(key))
+        labels = key_labels(key)
+        with self._lock:
+            if key in self._entries:
+                self.counters["hit"] += 1
+                self._entries.move_to_end(key)
+                fn = self._entries[key]
+                om.emit("serve", event="cache_hit", bucket=bucket_label(key), **labels)
+                return fn
+            self.counters["miss"] += 1
+        om.emit("serve", event="cache_miss", bucket=bucket_label(key), **labels)
         t0 = time.perf_counter()
         with serving(key):
             fn = builder()
         om.emit(
             "serve", event="compile", bucket=bucket_label(key),
-            seconds=time.perf_counter() - t0,
+            seconds=time.perf_counter() - t0, **labels,
         )
-        self._entries[key] = fn
-        while len(self._entries) > self.capacity:
-            old, _ = self._entries.popitem(last=False)
-            self.counters["evict"] += 1
-            om.emit("serve", event="cache_evict", bucket=bucket_label(old))
+        evicted = []
+        with self._lock:
+            if key in self._entries:
+                # lost a build race to another worker: keep the winner
+                self._entries.move_to_end(key)
+                fn = self._entries[key]
+            else:
+                self._entries[key] = fn
+            while len(self._entries) > self.capacity:
+                old, _ = self._entries.popitem(last=False)
+                self.counters["evict"] += 1
+                evicted.append(old)
+        for old in evicted:
+            om.emit("serve", event="cache_evict", bucket=bucket_label(old),
+                    **key_labels(old))
         return fn
 
 

@@ -1,0 +1,473 @@
+"""Serve v2 front door: continuous batching, tenant QoS, mesh failover.
+
+The PR-5 :class:`~dlaf_tpu.serve.pool.SolverPool` batches whatever happens
+to be queued when its worker wakes — good enough for one trusted caller,
+but a shared service needs admission control and placement on top.  The
+:class:`Gateway` is that layer, an asyncio-friendly front door over one or
+more pools:
+
+* **Continuous batching** — admitted requests flow through a
+  weighted-fair queue into per-group *forming* batches.  A batch
+  dispatches the moment it reaches ``max_batch``, or when its oldest
+  member has lingered ``tune.serve_linger_ms`` — so a request arriving
+  3 ms after a compatible one rides the same executable launch instead of
+  waiting a full pool cycle, and a lone request is delayed at most the
+  linger, never indefinitely.
+
+* **Per-tenant QoS** — each tenant has a :class:`~dlaf_tpu.serve.qos.
+  TenantConfig`: token-bucket quota (shed with
+  :class:`~dlaf_tpu.health.TenantQuotaExceededError`), weighted-fair
+  share, strict priority lane, and a pending bound.  Under overflow the
+  gateway first drops deadline-expired queued requests, then evicts the
+  least-urgent strictly-lower-priority request
+  (:class:`~dlaf_tpu.health.QueueFullError`) to admit an urgent one —
+  deadline-aware eviction means an expired request NEVER reaches
+  dispatch.
+
+* **Multi-mesh routing** — placement and failover delegate to
+  :class:`~dlaf_tpu.serve.router.Router`; :meth:`Gateway.check_replicas`
+  runs one probe/drain sweep.  Because a request's client-facing future
+  IS the pool request future (``pool.make_request`` at admission,
+  ``pool.adopt`` at dispatch), migrating a downed mesh's queue to a
+  sibling needs no re-resolution plumbing — the same future completes
+  from whichever pool runs it.
+
+Every admission outcome is observable: ``gw_batch`` (fill ratio, linger),
+``gw_done`` (per-request latency + outcome), ``gw_evict`` / ``gw_shed_*``
+(QoS actions), ``gw_hold`` (backend saturation), and a per-tenant
+``gw_slo`` roll-up (p50/p95/p99, counts) at close — all kind ``serve``
+through the schema-versioned ``obs.metrics`` stream.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from dlaf_tpu.health import (
+    ConfigurationError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    DistributionError,
+    QueueFullError,
+    TenantQuotaExceededError,
+)
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve import qos
+from dlaf_tpu.serve.pool import make_request
+from dlaf_tpu.serve.router import Replica, Router
+
+
+def _pct(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of an ascending list (0.0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(int(q * len(sorted_vals) + 0.999999) - 1, 0)
+    return float(sorted_vals[min(idx, len(sorted_vals) - 1)])
+
+
+class Gateway:
+    """Multi-tenant batching front door over one or more solver pools.
+
+    ``replicas`` is a :class:`Router`, an iterable of :class:`Replica`,
+    or a bare pool (wrapped as a single replica).  ``tenants`` is the
+    admission whitelist — submissions from unconfigured tenants raise
+    :class:`ConfigurationError`.  ``max_queue`` bounds gateway-held
+    requests (``tune.serve_gateway_max_queue``); ``max_batch`` is the
+    dispatch batch bound and the denominator of the fill ratio
+    (``tune.serve_max_batch``); ``linger_ms`` the continuous-batching
+    window (``tune.serve_linger_ms``).  Use as a context manager or call
+    :meth:`close` (the gateway never closes the pools it routes to)."""
+
+    def __init__(self, replicas, tenants, *, max_queue: int | None = None,
+                 max_batch: int | None = None, linger_ms: float | None = None):
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        if isinstance(replicas, Router):
+            self.router = replicas
+        elif hasattr(replicas, "adopt"):
+            self.router = Router([Replica("replica0", replicas)])
+        else:
+            self.router = Router(list(replicas))
+        self.tenants = {}
+        for cfg in tenants:
+            if not isinstance(cfg, qos.TenantConfig):
+                raise ConfigurationError(
+                    f"gateway: tenants must be TenantConfig, got {type(cfg).__name__}"
+                )
+            if cfg.name in self.tenants:
+                raise ConfigurationError(f"gateway: duplicate tenant {cfg.name!r}")
+            self.tenants[cfg.name] = cfg
+        if not self.tenants:
+            raise ConfigurationError("gateway: need at least one tenant")
+        self.max_queue = int(
+            max_queue if max_queue is not None else p.serve_gateway_max_queue
+        )
+        self.max_batch = int(max_batch if max_batch is not None else p.serve_max_batch)
+        if self.max_queue < 1 or self.max_batch < 1:
+            raise DistributionError(
+                f"gateway: bounds must be >= 1 "
+                f"(max_queue={self.max_queue}, max_batch={self.max_batch})"
+            )
+        linger_ms = float(linger_ms if linger_ms is not None else p.serve_linger_ms)
+        self.linger_s = max(linger_ms, 0.0) / 1e3
+
+        self._cond = threading.Condition()  # RLock: done-callbacks re-enter
+        self._fq = qos.FairQueue()          # holds (request, tenant_cfg) pairs
+        self._buckets = {
+            n: qos.TokenBucket(c.rate, c.burst) for n, c in self.tenants.items()
+        }
+        self._forming: dict = {}            # group_key -> {t0, t_flush, pairs}
+        self._forming_n = 0
+        self._pending = {n: 0 for n in self.tenants}
+        self._lat = {n: [] for n in self.tenants}      # completed-ok latencies
+        self._counters = {
+            n: {"admitted": 0, "shed_quota": 0, "shed_full": 0,
+                "evict_deadline": 0, "evict_priority": 0,
+                "done_ok": 0, "done_err": 0}
+            for n in self.tenants
+        }
+        self._gw = {"batches": 0, "dispatched": 0, "fill_sum": 0.0}
+        self._hold_until = 0.0              # backend-full / no-replica backoff
+        self._closed = False
+        self._dispatcher = threading.Thread(
+            target=self._run, name="dlaf-serve-gateway", daemon=True
+        )
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------ admission
+
+    def submit_nowait(self, tenant: str, kind: str, uplo: str, a, b=None, *,
+                      deadline_s: float | None = None):
+        """Admit one request; returns a ``concurrent.futures.Future``
+        resolving to :class:`~dlaf_tpu.serve.pool.ServeResult`.
+
+        Sheds synchronously with :class:`TenantQuotaExceededError` (quota)
+        or :class:`QueueFullError` (tenant pending bound, or gateway queue
+        full with nothing lower-priority to evict); validation errors
+        raise :class:`DistributionError` as in ``SolverPool.submit``."""
+        cfg = self.tenants.get(tenant)
+        if cfg is None:
+            raise ConfigurationError(
+                f"gateway: unknown tenant {tenant!r}; configured tenants: "
+                f"{sorted(self.tenants)}"
+            )
+        req = make_request(kind, uplo, a, b, deadline_s=deadline_s)
+        with self._cond:
+            if self._closed:
+                raise DistributionError("serve: gateway is closed")
+            c = self._counters[tenant]
+            if not self._buckets[tenant].try_take():
+                c["shed_quota"] += 1
+                om.emit("serve", event="gw_shed_quota", tenant=tenant, op=kind)
+                raise TenantQuotaExceededError(tenant, cfg.rate or 0.0)
+            if cfg.max_pending is not None and self._pending[tenant] >= cfg.max_pending:
+                c["shed_full"] += 1
+                om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
+                        scope="tenant")
+                raise QueueFullError(
+                    self._pending[tenant], cfg.max_pending,
+                    message=(
+                        f"tenant {tenant!r} has {self._pending[tenant]} pending "
+                        f"requests at its bound {cfg.max_pending}"
+                    ),
+                )
+            if self._queued_locked() >= self.max_queue:
+                self._make_room_locked(cfg)
+            if self._queued_locked() >= self.max_queue:
+                c["shed_full"] += 1
+                om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
+                        scope="gateway")
+                raise QueueFullError(self._queued_locked(), self.max_queue)
+            c["admitted"] += 1
+            self._pending[tenant] += 1
+            self._fq.push((req, cfg), cfg)
+            self._cond.notify_all()
+        req.future.add_done_callback(
+            lambda fut, req=req, cfg=cfg: self._on_done(req, cfg, fut)
+        )
+        return req.future
+
+    async def submit(self, tenant: str, kind: str, uplo: str, a, b=None, *,
+                     deadline_s: float | None = None):
+        """Async submission: awaits the result on the running event loop.
+
+        Shedding raises immediately (before the first await); backend
+        failures surface as the same typed exceptions the future carries."""
+        import asyncio
+
+        fut = self.submit_nowait(tenant, kind, uplo, a, b, deadline_s=deadline_s)
+        return await asyncio.wrap_future(fut)
+
+    def _queued_locked(self) -> int:
+        return len(self._fq) + self._forming_n
+
+    def _make_room_locked(self, cfg: qos.TenantConfig) -> None:
+        """Overflow handling: drop the dead, then evict the less urgent.
+
+        First purges queued requests whose deadline already expired (they
+        could never dispatch anyway); if the queue is still full, evicts
+        the least-urgent request from a strictly lower-priority lane than
+        the admitting tenant's — equal-or-higher priority work is never
+        displaced, so overflow cannot be weaponised laterally."""
+        now = time.monotonic()
+        for vreq, vcfg in self._fq.remove_if(
+            lambda pair: pair[0].expiry is not None and pair[0].expiry <= now
+        ):
+            self._evict_locked(vreq, vcfg, reason="deadline", where="queued")
+        for key, fb in list(self._forming.items()):
+            dead = [p for p in fb["pairs"]
+                    if p[0].expiry is not None and p[0].expiry <= now]
+            for pair in dead:
+                self._remove_forming_locked(key, pair)
+                self._evict_locked(pair[0], pair[1], reason="deadline",
+                                   where="forming")
+        while self._queued_locked() >= self.max_queue:
+            victim = self._evict_victim_locked(cfg.lane)
+            if victim is None:
+                return
+            vreq, vcfg = victim
+            self._evict_locked(vreq, vcfg, reason="priority", where="queued")
+
+    def _remove_forming_locked(self, key, pair) -> None:
+        fb = self._forming.get(key)
+        if fb is None or pair not in fb["pairs"]:
+            return
+        fb["pairs"].remove(pair)
+        self._forming_n -= 1
+        if not fb["pairs"]:
+            del self._forming[key]
+
+    def _evict_victim_locked(self, max_lane: int):
+        """The least-urgent (request, cfg) pair from a lane strictly below
+        ``max_lane``'s urgency — searched in the fair queue first, then in
+        forming batches (the dispatcher moves work there eagerly, so under
+        saturation both stores hold evictable requests)."""
+        victim = self._fq.evict_worst(max_lane=max_lane)
+        if victim is not None:
+            return victim
+        worst = None
+        for key, fb in self._forming.items():
+            for pair in fb["pairs"]:
+                if pair[1].lane > max_lane and (
+                    worst is None or pair[1].lane > worst[1][1].lane
+                ):
+                    worst = (key, pair)
+        if worst is None:
+            return None
+        self._remove_forming_locked(worst[0], worst[1])
+        return worst[1]
+
+    def _evict_locked(self, req, cfg, *, reason: str, where: str) -> None:
+        self._counters[cfg.name][f"evict_{reason}"] += 1
+        om.emit("serve", event="gw_evict", tenant=cfg.name, op=req.kind,
+                reason=reason, where=where)
+        if not req.future.done():
+            if reason == "deadline":
+                req.future.set_exception(DeadlineExceededError(
+                    0.0, label=f"gateway:{req.kind}:{where}"
+                ))
+            else:
+                req.future.set_exception(QueueFullError(
+                    self.max_queue, self.max_queue,
+                    message=(
+                        f"request from tenant {cfg.name!r} evicted from a full "
+                        f"gateway queue by a higher-priority admission"
+                    ),
+                ))
+
+    def _on_done(self, req, cfg, fut) -> None:
+        lat = time.monotonic() - req.t_submit
+        if fut.cancelled():
+            outcome = "cancelled"
+        else:
+            exc = fut.exception()
+            outcome = type(exc).__name__ if exc is not None else "ok"
+        with self._cond:
+            self._pending[cfg.name] -= 1
+            c = self._counters[cfg.name]
+            if outcome == "ok":
+                c["done_ok"] += 1
+                self._lat[cfg.name].append(lat)
+            else:
+                c["done_err"] += 1
+            self._cond.notify_all()
+        om.emit("serve", event="gw_done", tenant=cfg.name, op=req.kind,
+                outcome=outcome, latency_s=lat)
+
+    # ----------------------------------------------------------- dispatcher
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while True:
+                    if self._closed and not len(self._fq) and not self._forming:
+                        return
+                    timeout = self._wait_timeout_locked(time.monotonic())
+                    if timeout == 0.0:
+                        break
+                    self._cond.wait(timeout)
+                self._pump_locked()
+
+    def _wait_timeout_locked(self, now: float):
+        """Seconds until the dispatcher has work (0.0 = work is ready,
+        None = idle until notified)."""
+        if not len(self._fq) and not self._forming:
+            return None
+        bounds = []
+        if len(self._fq):
+            bounds.append(self._hold_until - now)
+        if self._forming:
+            t = min(fb["t_flush"] for fb in self._forming.values())
+            if self._closed:
+                t = now
+            bounds.append(max(t, self._hold_until) - now)
+        return max(min(bounds), 0.0)
+
+    def _pump_locked(self) -> None:
+        now = time.monotonic()
+        if now < self._hold_until:
+            return
+        # pop in WFQ service order into per-group forming batches; a full
+        # batch flushes immediately, everything else waits out its linger
+        while len(self._fq):
+            req, cfg = self._fq.pop()
+            if req.expiry is not None and req.expiry <= now:
+                self._evict_locked(req, cfg, reason="deadline", where="queued")
+                continue
+            key = req.group_key()
+            fb = self._forming.get(key)
+            if fb is None:
+                fb = self._forming[key] = {
+                    "t0": now, "t_flush": now + self.linger_s, "pairs": [],
+                }
+            fb["pairs"].append((req, cfg))
+            self._forming_n += 1
+            if len(fb["pairs"]) >= self.max_batch:
+                self._flush_locked(key, now)
+        for key in [k for k, fb in self._forming.items()
+                    if fb["t_flush"] <= now or self._closed]:
+            if key in self._forming:
+                self._flush_locked(key, now)
+
+    def _flush_locked(self, key, now: float) -> None:
+        fb = self._forming.pop(key)
+        self._forming_n -= len(fb["pairs"])
+        live = []
+        for req, cfg in fb["pairs"]:
+            # a request that expired while lingering is shed, NOT dispatched
+            if req.expiry is not None and req.expiry <= now:
+                self._evict_locked(req, cfg, reason="deadline", where="forming")
+            else:
+                live.append((req, cfg))
+        if not live:
+            return
+        rep = self.router.route()
+        if rep is None:
+            if self._closed:
+                for req, cfg in live:
+                    if not req.future.done():
+                        req.future.set_exception(DeviceUnresponsiveError(
+                            message=(
+                                "gateway closed with no healthy replica to "
+                                f"dispatch {req.kind} request"
+                            ),
+                        ))
+                return
+            # every mesh is down: hold the batch and retry after a backoff
+            backoff = max(self.linger_s, 0.05)
+            fb["pairs"] = live
+            fb["t_flush"] = now + backoff
+            self._forming[key] = fb
+            self._forming_n += len(live)
+            self._hold_until = max(self._hold_until, now + backoff)
+            om.emit("serve", event="gw_hold", reason="no_replica", batch=len(live))
+            return
+        overflow = rep.pool.adopt([req for req, _ in live])
+        adopted = len(live) - len(overflow)
+        if adopted:
+            fill = adopted / self.max_batch
+            self._gw["batches"] += 1
+            self._gw["dispatched"] += adopted
+            self._gw["fill_sum"] += fill
+            om.emit("serve", event="gw_batch", replica=rep.name, op=key[0],
+                    bucket=str(key[2]), batch=adopted, fill=fill,
+                    linger_s=now - fb["t0"])
+        if overflow:
+            # adopt keeps order, so the overflow is live's tail: requeue it
+            # and back off before pumping again rather than spinning hot
+            for req, cfg in live[adopted:]:
+                self._fq.push((req, cfg), cfg)
+            self._hold_until = max(
+                self._hold_until, now + max(self.linger_s, 0.005)
+            )
+            om.emit("serve", event="gw_hold", reason="backend_full",
+                    replica=rep.name, batch=len(overflow))
+
+    # ------------------------------------------------------------- failover
+
+    def check_replicas(self, probe_budget_s: float | None = None) -> dict:
+        """One router failover sweep (probe, down, drain, revive); wakes
+        the dispatcher so held work re-routes immediately.  See
+        :meth:`Router.check` for the returned summary."""
+        summary = self.router.check(probe_budget_s)
+        with self._cond:
+            self._hold_until = 0.0
+            self._cond.notify_all()
+        return summary
+
+    # ------------------------------------------------------------ lifecycle
+
+    def stats(self) -> dict:
+        """Snapshot of per-tenant SLO state and gateway throughput."""
+        with self._cond:
+            tenants = {}
+            for name in self.tenants:
+                lats = sorted(self._lat[name])
+                tenants[name] = {
+                    **self._counters[name],
+                    "pending": self._pending[name],
+                    "p50_s": _pct(lats, 0.50),
+                    "p95_s": _pct(lats, 0.95),
+                    "p99_s": _pct(lats, 0.99),
+                }
+            batches = self._gw["batches"]
+            return {
+                "tenants": tenants,
+                "queued": self._queued_locked(),
+                "batches": batches,
+                "dispatched": self._gw["dispatched"],
+                "batch_fill": self._gw["fill_sum"] / batches if batches else 0.0,
+            }
+
+    def close(self, timeout: float | None = 60.0) -> None:
+        """Stop admission, flush the queue, wait (bounded) for outstanding
+        futures, then emit the per-tenant ``gw_slo`` roll-up and a
+        ``gw_summary`` event.  The routed pools are NOT closed — the
+        caller owns their lifecycle."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._dispatcher.join(timeout=30.0)
+        expiry = None if timeout is None else time.monotonic() + float(timeout)
+        with self._cond:
+            while sum(self._pending.values()) > 0:
+                rem = None if expiry is None else expiry - time.monotonic()
+                if rem is not None and rem <= 0:
+                    break
+                self._cond.wait(min(rem, 1.0) if rem is not None else 1.0)
+        st = self.stats()
+        for name, t in st["tenants"].items():
+            om.emit("serve", event="gw_slo", tenant=name, **t)
+        om.emit("serve", event="gw_summary", batches=st["batches"],
+                dispatched=st["dispatched"], batch_fill=st["batch_fill"],
+                queued=st["queued"])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -51,6 +51,16 @@ from dlaf_tpu.serve import batched, bucketing
 
 KINDS = ("potrf", "posv", "eigh")
 
+# XLA's CPU backend deadlocks when two executables over the same global
+# device set run their cross-module collectives concurrently: each
+# rendezvous waits for ALL participants to arrive at the SAME op, and two
+# interleaved programs starve each other's rendezvous forever.  One
+# process = one device set, so batched EXECUTION serializes process-wide;
+# multi-replica routing still overlaps queueing/padding/slicing, and real
+# multi-mesh replicas live in separate processes where this is never
+# contended.
+_EXEC_LOCK = threading.Lock()
+
 
 @dataclass
 class ServeResult:
@@ -110,6 +120,44 @@ def _pad_rows(b: np.ndarray, n_to: int) -> np.ndarray:
     return out
 
 
+def make_request(kind: str, uplo: str, a, b=None, *,
+                 deadline_s: float | None = None) -> _Request:
+    """Validate one problem and wrap it as a queueable :class:`_Request`
+    (fresh future, expiry captured now).  Shared by :meth:`SolverPool.submit`
+    and the gateway's admission path, so a request validated at the front
+    door is dispatchable on ANY pool without re-checking."""
+    if kind not in KINDS:
+        raise DistributionError(f"serve: unknown request kind {kind!r}; use {KINDS}")
+    a = np.asarray(a)
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise DistributionError(
+            f"serve: request matrix must be square 2-D, got shape {a.shape}"
+        )
+    squeeze = False
+    if kind == "posv":
+        if b is None:
+            raise DistributionError("serve: posv request needs a right-hand side b")
+        b = np.asarray(b)
+        squeeze = b.ndim == 1
+        if squeeze:
+            b = b[:, None]
+        if b.ndim != 2 or b.shape[0] != a.shape[0]:
+            raise DistributionError(
+                f"serve: b must be (n,) or (n, k) with n={a.shape[0]}, "
+                f"got shape {b.shape}"
+            )
+    elif b is not None:
+        raise DistributionError(f"serve: {kind} request takes no right-hand side")
+    if deadline_s is None:
+        deadline_s = resilience.remaining()
+    expiry = None if deadline_s is None else time.monotonic() + float(deadline_s)
+    return _Request(
+        kind=kind, uplo=uplo, a=a, b=b, squeeze=squeeze, n=a.shape[0],
+        bucket=bucketing.bucket_for(a.shape[0]), future=Future(),
+        t_submit=time.monotonic(), expiry=expiry,
+    )
+
+
 class SolverPool:
     """Batched solver service over one device grid (default: all devices).
 
@@ -136,6 +184,12 @@ class SolverPool:
                 f"serve: pool bounds must be >= 1 "
                 f"(max_queue={self.max_queue}, max_batch={self.max_batch})"
             )
+        # cold-start accounting: group keys this pool has dispatched before.
+        # The FIRST dispatch of a group compiles its bucket executable; that
+        # one-time cost is budgeted separately (serve_compile_grace_s), not
+        # against the member requests' own deadlines.
+        self.compile_grace_s = max(float(p.serve_compile_grace_s), 0.0)
+        self._warm: set = set()
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -152,36 +206,7 @@ class SolverPool:
         :class:`ServeResult`.  ``kind`` in {'potrf', 'posv', 'eigh'};
         ``posv`` needs ``b`` of shape ``(n,)`` or ``(n, k)`` (result rank
         matches).  Raises :class:`QueueFullError` beyond ``max_queue``."""
-        if kind not in KINDS:
-            raise DistributionError(f"serve: unknown request kind {kind!r}; use {KINDS}")
-        a = np.asarray(a)
-        if a.ndim != 2 or a.shape[0] != a.shape[1]:
-            raise DistributionError(
-                f"serve: request matrix must be square 2-D, got shape {a.shape}"
-            )
-        squeeze = False
-        if kind == "posv":
-            if b is None:
-                raise DistributionError("serve: posv request needs a right-hand side b")
-            b = np.asarray(b)
-            squeeze = b.ndim == 1
-            if squeeze:
-                b = b[:, None]
-            if b.ndim != 2 or b.shape[0] != a.shape[0]:
-                raise DistributionError(
-                    f"serve: b must be (n,) or (n, k) with n={a.shape[0]}, "
-                    f"got shape {b.shape}"
-                )
-        elif b is not None:
-            raise DistributionError(f"serve: {kind} request takes no right-hand side")
-        if deadline_s is None:
-            deadline_s = resilience.remaining()
-        expiry = None if deadline_s is None else time.monotonic() + float(deadline_s)
-        req = _Request(
-            kind=kind, uplo=uplo, a=a, b=b, squeeze=squeeze, n=a.shape[0],
-            bucket=bucketing.bucket_for(a.shape[0]), future=Future(),
-            t_submit=time.monotonic(), expiry=expiry,
-        )
+        req = make_request(kind, uplo, a, b, deadline_s=deadline_s)
         with self._cond:
             if self._closed:
                 raise DistributionError("serve: pool is closed")
@@ -190,6 +215,39 @@ class SolverPool:
             self._queue.append(req)
             self._cond.notify()
         return req.future
+
+    def adopt(self, reqs) -> list:
+        """Enqueue already-built :class:`_Request` objects (from
+        :func:`make_request`, another pool's :meth:`drain`, or the
+        gateway's dispatcher) WITHOUT resolving their futures — the
+        original future completes from THIS pool, which is what lets the
+        router migrate a downed pool's queue to a sibling transparently.
+
+        Capacity-bounded like :meth:`submit`, but instead of raising, the
+        requests that do not fit (queue full, or this pool closed) are
+        returned to the caller untouched — the caller decides whether to
+        retry elsewhere or shed them with a typed error."""
+        reqs = list(reqs)
+        overflow: list = []
+        with self._cond:
+            for i, req in enumerate(reqs):
+                if self._closed or len(self._queue) >= self.max_queue:
+                    overflow = reqs[i:]
+                    break
+                self._queue.append(req)
+            self._cond.notify()
+        return overflow
+
+    def drain(self) -> list:
+        """Remove and return every queued-but-undispatched request (the
+        in-flight dispatch, if any, is not interrupted).  The returned
+        :class:`_Request` objects keep their futures, submit times and
+        expiries — :meth:`adopt` them on a sibling pool to fail over, or
+        fail their futures with a typed error to shed."""
+        with self._cond:
+            drained = list(self._queue)
+            self._queue.clear()
+        return drained
 
     def result(self, future: Future, timeout: float | None = None) -> ServeResult:
         """Wait for a submitted request (thin ``future.result`` wrapper)."""
@@ -233,7 +291,13 @@ class SolverPool:
             groups: dict = {}
             for req in batch:
                 rem = req.remaining()
-                if rem is not None and rem <= 0:
+                # a COLD group's members get the compile grace on top of
+                # their own budget even at the queued-expiry check: the
+                # time they sat behind the first compile is grace, not
+                # deadline (satellite: cold replicas must not shed their
+                # very first requests)
+                grace = 0.0 if req.group_key() in self._warm else self.compile_grace_s
+                if rem is not None and rem + grace <= 0:
                     req.future.set_exception(
                         DeadlineExceededError(0.0, label=f"serve:{req.kind}:queued")
                     )
@@ -248,10 +312,26 @@ class SolverPool:
                             r.future.set_exception(exc)
 
     def _dispatch(self, key, reqs) -> None:
+        # deadline budgets are computed inside the lock: time spent
+        # waiting for a sibling pool's dispatch is queue time, and the
+        # queued-expiry check in _run re-screens on the next wakeup
+        with _EXEC_LOCK:
+            self._dispatch_locked(key, reqs)
+
+    def _dispatch_locked(self, key, reqs) -> None:
         kind, uplo, bucket, _, _, _ = key
         t0 = time.monotonic()
         budgets = [r.remaining() for r in reqs if r.expiry is not None]
         seconds = min(budgets) if budgets else None
+        cold = key not in self._warm
+        self._warm.add(key)
+        if cold and seconds is not None and self.compile_grace_s > 0:
+            # first dispatch of this group: the bucket executable compiles
+            # inside the bounded call — budget that separately so the
+            # tightest member's deadline still bounds the SOLVE
+            seconds += self.compile_grace_s
+            om.emit("serve", event="compile_grace", op=kind, bucket=str(bucket),
+                    grace_s=self.compile_grace_s, budget_s=seconds)
         # potrf/posv members are padded to the common bucket order: one
         # executable, results sliced back per element (blockdiag-identity
         # padding is exact — see batched.py); eigh members share n already

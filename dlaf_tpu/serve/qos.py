@@ -1,0 +1,186 @@
+"""Multi-tenant QoS primitives for the serve gateway.
+
+Production traffic from many tenants cannot share one FIFO: a chatty
+tenant starves everyone, a latency-critical tenant queues behind bulk
+work, and under overload the queue must shed the RIGHT requests.  Three
+primitives, all host-side and lock-free (the gateway serializes access
+under its own condition variable):
+
+* :class:`TenantConfig` — one tenant's service contract: token-bucket
+  quota (``rate`` requests/second refill into a ``burst``-deep bucket),
+  weighted-fair share (``weight``), priority lane (``lane`` — lower is
+  more urgent, strict priority across lanes), and a per-tenant pending
+  bound.
+
+* :class:`TokenBucket` — the classic admission quota.  ``try_take``
+  refills lazily from the monotonic clock, so an idle tenant accumulates
+  at most ``burst`` tokens and a steady one is clamped to ``rate``.
+
+* :class:`FairQueue` — strict priority lanes, weighted-fair queueing
+  within each lane (start-time fair queueing virtual clock: each item's
+  finish tag is ``max(lane_vtime, tenant_last_tag) + 1/weight``; dequeue
+  takes the smallest tag in the most urgent non-empty lane).  A tenant
+  with weight 2 drains twice as fast as a weight-1 tenant under
+  contention, and an idle tenant's backlog does not build up credit.
+  ``evict_worst`` removes the least-urgent queued item (highest lane,
+  largest tag) for priority eviction under overload.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's QoS contract.
+
+    ``rate`` is the token-bucket refill in requests/second (``None`` =
+    unlimited, no quota shedding); ``burst`` bounds how many requests the
+    tenant may land instantaneously after idling.  ``weight`` is the
+    weighted-fair share inside the tenant's ``lane`` (larger = more
+    throughput under contention).  ``lane`` is the strict priority class:
+    lane 0 requests always dispatch before lane 1, whatever the weights.
+    ``max_pending`` bounds this tenant's admitted-but-unfinished requests
+    (``None`` = only the gateway-wide bound applies)."""
+
+    name: str
+    rate: float | None = None
+    burst: int = 64
+    weight: float = 1.0
+    lane: int = 1
+    max_pending: int | None = None
+
+    def __post_init__(self):
+        from dlaf_tpu.health import ConfigurationError
+
+        if self.rate is not None and self.rate <= 0:
+            raise ConfigurationError(
+                f"tenant {self.name!r}: rate must be positive or None, got {self.rate}"
+            )
+        if self.burst < 1:
+            raise ConfigurationError(
+                f"tenant {self.name!r}: burst must be >= 1, got {self.burst}"
+            )
+        if self.weight <= 0:
+            raise ConfigurationError(
+                f"tenant {self.name!r}: weight must be positive, got {self.weight}"
+            )
+        if self.lane < 0:
+            raise ConfigurationError(
+                f"tenant {self.name!r}: lane must be >= 0, got {self.lane}"
+            )
+        if self.max_pending is not None and self.max_pending < 1:
+            raise ConfigurationError(
+                f"tenant {self.name!r}: max_pending must be >= 1 or None, "
+                f"got {self.max_pending}"
+            )
+
+
+class TokenBucket:
+    """Lazily refilled token bucket (``rate`` tokens/s, depth ``burst``)."""
+
+    def __init__(self, rate: float | None, burst: int):
+        self.rate = None if rate is None else float(rate)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._t_last = time.monotonic()
+
+    def try_take(self, now: float | None = None) -> bool:
+        """Take one token if available; False = quota exhausted."""
+        if self.rate is None:
+            return True
+        now = time.monotonic() if now is None else now
+        elapsed = max(now - self._t_last, 0.0)
+        self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
+        self._t_last = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+
+class FairQueue:
+    """Priority lanes + weighted-fair queueing of opaque items.
+
+    Items are pushed with their tenant's :class:`TenantConfig`; ``pop``
+    returns them in service order.  Not thread-safe by design — the
+    gateway owns the lock."""
+
+    def __init__(self):
+        self._lanes: dict = {}          # lane -> heap of (tag, seq, item, tenant)
+        self._vtime: dict = {}          # lane -> virtual clock
+        self._last_tag: dict = {}       # tenant -> last assigned finish tag
+        self._seq = itertools.count()
+        self._len = 0
+
+    def __len__(self) -> int:
+        return self._len
+
+    def push(self, item, cfg: TenantConfig) -> None:
+        heap = self._lanes.setdefault(cfg.lane, [])
+        v = self._vtime.setdefault(cfg.lane, 0.0)
+        tag = max(v, self._last_tag.get(cfg.name, 0.0)) + 1.0 / cfg.weight
+        self._last_tag[cfg.name] = tag
+        heapq.heappush(heap, (tag, next(self._seq), item, cfg.name))
+        self._len += 1
+
+    def pop(self):
+        """The most urgent queued item (None when empty): smallest finish
+        tag within the lowest-numbered non-empty lane."""
+        for lane in sorted(self._lanes):
+            heap = self._lanes[lane]
+            if heap:
+                tag, _, item, _ = heapq.heappop(heap)
+                self._vtime[lane] = max(self._vtime[lane], tag)
+                self._len -= 1
+                return item
+        return None
+
+    def evict_worst(self, max_lane: int | None = None):
+        """Remove and return the LEAST urgent queued item (largest finish
+        tag in the highest-numbered non-empty lane), or None when empty.
+        With ``max_lane``, only items in lanes strictly BELOW that urgency
+        (lane > max_lane) are eligible — a request never evicts its peers
+        or its betters."""
+        for lane in sorted(self._lanes, reverse=True):
+            if max_lane is not None and lane <= max_lane:
+                continue
+            heap = self._lanes[lane]
+            if not heap:
+                continue
+            idx = max(range(len(heap)), key=lambda i: heap[i][:2])
+            entry = heap[idx]
+            heap[idx] = heap[-1]
+            heap.pop()
+            if idx < len(heap):
+                heapq.heapify(heap)
+            self._len -= 1
+            return entry[2]
+        return None
+
+    def remove_if(self, pred) -> list:
+        """Remove and return every queued item for which ``pred(item)`` is
+        true (e.g. purge deadline-expired requests before evicting live
+        ones).  O(queue) — called only on the overflow path."""
+        removed = []
+        for lane, heap in self._lanes.items():
+            kept = []
+            for entry in heap:
+                (removed if pred(entry[2]) else kept).append(entry)
+            if len(kept) != len(heap):
+                heapq.heapify(kept)
+                self._lanes[lane] = kept
+        self._len -= len(removed)
+        return [e[2] for e in removed]
+
+    def drain(self) -> list:
+        """Remove and return every queued item in service order."""
+        out = []
+        while True:
+            item = self.pop()
+            if item is None:
+                return out
+            out.append(item)

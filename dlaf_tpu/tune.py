@@ -139,6 +139,27 @@ class TuneParameters:
       beyond this many queued requests raise ``QueueFullError``.
     - ``serve_max_batch``: most requests the pool worker fuses into one
       batched dispatch.
+    - ``serve_linger_ms``: the gateway's continuous-batching max-linger —
+      a forming bucket batch dispatches as soon as it is FULL
+      (``serve_max_batch`` members), and a partial batch dispatches once
+      its oldest member has lingered this many milliseconds; until then a
+      newly admitted compatible request joins the in-flight forming batch
+      instead of waiting for a fresh group.  0 = dispatch whatever is
+      formed as soon as the dispatcher sees it (lowest latency, lowest
+      batch fill).
+    - ``serve_compile_grace_s``: first-compile grace budget for a COLD
+      serve bucket — the first dispatch of a (kind, bucket, dtype, ...)
+      group on a pool extends its deadline budget by this many seconds so
+      one-time executable compilation does not count against the
+      requests' own deadlines (a cold replica no longer sheds its very
+      first requests).  Consumed grace is emitted as a ``serve``
+      ``compile_grace`` event.  0 disables (compile time counts against
+      request deadlines again).
+    - ``serve_gateway_max_queue``: gateway admission bound — beyond this
+      many admitted-but-undispatched requests (fair queue + forming
+      batches) the gateway sheds: expired requests are evicted first,
+      then the lowest-priority queued request if the newcomer outranks
+      it, else the newcomer is rejected with ``QueueFullError``.
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -186,6 +207,13 @@ class TuneParameters:
     )
     serve_max_queue: int = field(default_factory=lambda: _env("serve_max_queue", 256, int))
     serve_max_batch: int = field(default_factory=lambda: _env("serve_max_batch", 64, int))
+    serve_linger_ms: float = field(default_factory=lambda: _env("serve_linger_ms", 5.0, float))
+    serve_compile_grace_s: float = field(
+        default_factory=lambda: _env("serve_compile_grace_s", 120.0, float)
+    )
+    serve_gateway_max_queue: int = field(
+        default_factory=lambda: _env("serve_gateway_max_queue", 2048, int)
+    )
     panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
     dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(

@@ -234,6 +234,60 @@ def summarize(path: str) -> int:
             for b, (nreq, nbatch, secs) in sorted(rows.items()):
                 thr = f"{nreq / secs:11.1f}" if secs and nreq else f"{'-':>11s}"
                 print(f"   {b:>10s} {nreq:9d} {nbatch:8d} {thr}")
+        # cache churn attribution: hit/miss/evict per (op, n, dtype) labels
+        # carried by the bucketing events since the gateway PR
+        churn = defaultdict(lambda: [0, 0, 0])  # hits, misses, evicts
+        for r in serve:
+            if r["event"] in ("cache_hit", "cache_miss", "cache_evict") and "op" in r:
+                k = (r["op"], r.get("n", "?"), r.get("dtype", "?"))
+                idx = ("cache_hit", "cache_miss", "cache_evict").index(r["event"])
+                churn[k][idx] += 1
+        if churn:
+            print(f"   {'op':>8s} {'n':>6s} {'dtype':>6s} {'hits':>7s} "
+                  f"{'misses':>7s} {'evicts':>7s}")
+            for (op, n, dt), (h, m, e) in sorted(churn.items(), key=str):
+                print(f"   {op:>8s} {n!s:>6s} {dt:>6s} {h:7d} {m:7d} {e:7d}")
+        if counts.get("compile_grace"):
+            print(f"   cold-start compile grace consumed: "
+                  f"{counts['compile_grace']} dispatches")
+        # gateway roll-up: per-tenant SLO latencies + QoS action counts
+        gw_done = [r for r in serve if r["event"] == "gw_done"]
+        if gw_done:
+            per_tenant = defaultdict(lambda: {"lat": [], "ok": 0, "err": 0})
+            for r in gw_done:
+                t = per_tenant[r.get("tenant", "?")]
+                if r.get("outcome") == "ok":
+                    t["ok"] += 1
+                    t["lat"].append(float(r.get("latency_s", 0.0)))
+                else:
+                    t["err"] += 1
+            print(f"-- gateway ({len(gw_done)} completed requests):")
+            print(f"   {'tenant':>12s} {'ok':>7s} {'err':>6s} {'p50 ms':>8s} "
+                  f"{'p95 ms':>8s} {'p99 ms':>8s}")
+            for name, t in sorted(per_tenant.items()):
+                lat = sorted(t["lat"])
+
+                def pct(q, lat=lat):
+                    if not lat:
+                        return float("nan")
+                    return lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3
+
+                print(f"   {name:>12s} {t['ok']:7d} {t['err']:6d} "
+                      f"{pct(0.50):8.1f} {pct(0.95):8.1f} {pct(0.99):8.1f}")
+            batches = [r for r in serve if r["event"] == "gw_batch"]
+            if batches:
+                fill = sum(float(r.get("fill", 0.0)) for r in batches) / len(batches)
+                print(f"   batches: {len(batches)}  mean fill {fill:.2f}  "
+                      f"dispatched {sum(int(r.get('batch', 0)) for r in batches)}")
+            qos_counts = {e: n for e, n in sorted(counts.items())
+                          if e.startswith(("gw_shed", "gw_evict", "gw_hold"))}
+            if qos_counts:
+                print("   qos: " + "  ".join(f"{e}={n}" for e, n in qos_counts.items()))
+            fo = {e: n for e, n in counts.items()
+                  if e.startswith("replica_") and n}
+            if fo:
+                print("   failover: "
+                      + "  ".join(f"{e}={n}" for e, n in sorted(fo.items())))
 
     for r in by_kind.get("note", []):
         print(f"-- note (rank {r['rank']}): {r['text']}")

@@ -414,10 +414,11 @@ def test_pool_backpressure_queue_full():
 def test_pool_deadline_expires_in_queue():
     """A request whose budget is gone by dispatch time fails with
     DeadlineExceededError WITHOUT being dispatched; queue neighbours with
-    budget still complete."""
+    budget still complete.  Compile grace is pinned off — this asserts the
+    bare expiry path; the grace-covered cold path has its own tests."""
     n = 16
     a = tu.random_hermitian_pd(n, np.float32, seed=96)
-    with _tuned(serve_buckets="16"):
+    with _tuned(serve_buckets="16", serve_compile_grace_s=0.0):
         pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
         with pool:
             f_dead = pool.submit("potrf", "L", a, deadline_s=0.0)
@@ -480,6 +481,194 @@ def test_pool_info_codes_resolve_not_reject():
             f_good = pool.submit("potrf", "L", good)
             assert pool.result(f_bad, 300).info == 5
             assert pool.result(f_good, 300).info == 0
+
+
+def test_pool_racing_submitters_typed_backpressure():
+    """ISSUE 7 satellite: N threads racing into a full queue each get a
+    TYPED QueueFullError — no hangs, and every accepted request is
+    dispatched exactly once."""
+    n_threads, cap = 8, 2
+    a = tu.random_hermitian_pd(16, np.float32, seed=400)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(
+            max_queue=cap, block_size=8, cache=serve.CompiledCache()
+        )
+        dispatched = []
+        orig = pool._dispatch
+
+        def recording(key, reqs):
+            dispatched.extend(id(r.future) for r in reqs)
+            orig(key, reqs)
+
+        pool._dispatch = recording
+        try:
+            # worker holds one request at the gate; the queue is now empty
+            first = pool.submit("potrf", "L", a)
+            _drain_to_worker(pool)
+            start = threading.Barrier(n_threads)
+            outcomes = [None] * n_threads
+
+            def racer(i):
+                start.wait()
+                try:
+                    outcomes[i] = pool.submit("potrf", "L", a)
+                except QueueFullError as e:
+                    outcomes[i] = e
+
+            threads = [threading.Thread(target=racer, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            assert not any(t.is_alive() for t in threads)  # no hangs
+            accepted = [o for o in outcomes if not isinstance(o, QueueFullError)]
+            rejected = [o for o in outcomes if isinstance(o, QueueFullError)]
+            assert len(accepted) == cap  # exactly the queue capacity got in
+            assert len(rejected) == n_threads - cap
+            for e in rejected:
+                assert e.capacity == cap and e.size >= cap
+            gate.set()
+            assert first.result(300).info == 0
+            for f in accepted:
+                assert f.result(300).info == 0
+            # exactly once: every accepted future dispatched a single time
+            assert sorted(dispatched) == sorted(
+                {id(f) for f in [first] + accepted}
+            )
+        finally:
+            gate.set()
+            pool.close()
+
+
+# ---------------------------------------------------------- cold-start grace
+
+
+def test_pool_compile_grace_covers_cold_dispatch(tmp_path):
+    """ISSUE 7 satellite: the FIRST dispatch of a group budgets compile
+    time separately — a tight deadline that could never cover compilation
+    still completes cold, and the grace consumption is an obs event."""
+    path = str(tmp_path / "grace.jsonl")
+    a = tu.random_hermitian_pd(16, np.float32, seed=500)
+    om.enable(path)
+    try:
+        with _tuned(serve_buckets="16", serve_compile_grace_s=120.0):
+            with serve.SolverPool(block_size=8,
+                                  cache=serve.CompiledCache()) as pool:
+                # budget far smaller than any compile, but the group is cold
+                f = pool.submit("potrf", "L", a, deadline_s=1.0)
+                assert pool.result(f, 300).info == 0
+                # the group is warm now: a spent budget sheds pre-dispatch
+                f2 = pool.submit("potrf", "L", a, deadline_s=0.0)
+                with pytest.raises(DeadlineExceededError):
+                    pool.result(f2, 300)
+    finally:
+        om.close()
+    recs = [r for r in om.read_jsonl(path) if r["kind"] == "serve"]
+    grace = [r for r in recs if r["event"] == "compile_grace"]
+    assert len(grace) == 1
+    assert grace[0]["op"] == "potrf" and grace[0]["grace_s"] == 120.0
+    assert grace[0]["budget_s"] > 120.0
+
+
+def test_pool_no_grace_sheds_cold_expired():
+    """With the grace knob zeroed, PR-5 semantics return: a cold request
+    whose budget is spent sheds without dispatching."""
+    a = tu.random_hermitian_pd(16, np.float32, seed=501)
+    with _tuned(serve_buckets="16", serve_compile_grace_s=0.0):
+        with serve.SolverPool(block_size=8, cache=serve.CompiledCache()) as pool:
+            f = pool.submit("potrf", "L", a, deadline_s=0.0)
+            with pytest.raises(DeadlineExceededError):
+                pool.result(f, 300)
+
+
+# ------------------------------------------------------------- adopt / drain
+
+
+def test_pool_drain_adopt_preserves_futures():
+    """drain() hands queued requests (futures intact) to a sibling's
+    adopt(): the ORIGINAL futures resolve from the adopting pool."""
+    a = tu.random_hermitian_pd(16, np.float32, seed=600)
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pool_a, gate = _gated_pool(block_size=8, max_batch=1, cache=cache)
+        try:
+            with serve.SolverPool(block_size=8, cache=cache) as pool_b:
+                f_flight = pool_a.submit("potrf", "L", a)
+                _drain_to_worker(pool_a)  # worker holds it at the gate
+                queued = [pool_a.submit("potrf", "L",
+                                        tu.random_hermitian_pd(
+                                            16, np.float32, seed=601 + i))
+                          for i in range(3)]
+                drained = pool_a.drain()
+                assert len(drained) == 3 and pool_a.pending() == 0
+                assert pool_b.adopt(drained) == []  # all fit
+                for f in queued:
+                    assert f.result(timeout=300).info == 0  # resolved by b
+                gate.set()
+                assert f_flight.result(timeout=300).info == 0
+        finally:
+            gate.set()
+            pool_a.close()
+
+
+def test_pool_adopt_returns_overflow_untouched():
+    a = tu.random_hermitian_pd(16, np.float32, seed=610)
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pool, gate = _gated_pool(max_queue=1, block_size=8, cache=cache)
+        try:
+            f0 = pool.submit("potrf", "L", a)
+            _drain_to_worker(pool)
+            reqs = [serve.make_request("potrf", "L", a) for _ in range(3)]
+            overflow = pool.adopt(reqs)
+            assert overflow == reqs[1:]  # capacity 1: the tail comes back
+            assert all(not r.future.done() for r in overflow)  # untouched
+            gate.set()
+            assert f0.result(300).info == 0
+            assert reqs[0].future.result(timeout=300).info == 0
+            # a closed pool adopts nothing
+            pool.close()
+            assert pool.adopt(overflow) == overflow
+        finally:
+            gate.set()
+            pool.close()
+
+
+# --------------------------------------------------------- cache event labels
+
+
+def test_cache_events_carry_bucket_labels(tmp_path):
+    """ISSUE 7 satellite: hit/miss/evict events carry structured
+    (op, n, dtype) labels so report_metrics can attribute churn."""
+    from dlaf_tpu.serve.bucketing import key_labels
+
+    assert key_labels(("potrf", 32, "<f4", "L")) == {
+        "op": "potrf", "n": 32, "dtype": "<f4"
+    }
+    assert key_labels(("x",)) == {}
+    assert key_labels("not-a-tuple") == {}
+    path = str(tmp_path / "labels.jsonl")
+    om.enable(path)
+    try:
+        with _tuned(serve_buckets="16,32"):
+            cache = serve.CompiledCache(capacity=1)
+            for n in (16, 32, 16):  # miss, miss+evict, miss again
+                serve.batched_cholesky_factorization(
+                    "L", _spd_batch(1, n, np.float32, seed=n),
+                    block_size=8, shard_batch=True, cache=cache,
+                )
+    finally:
+        om.close()
+    recs = [r for r in om.read_jsonl(path) if r["kind"] == "serve"]
+    misses = [r for r in recs if r["event"] == "cache_miss"]
+    assert len(misses) == 3
+    for r in misses:
+        assert r["op"] == "potrf" and r["n"] in (16, 32)
+        assert r["dtype"] == np.dtype(np.float32).str
+    evicts = [r for r in recs if r["event"] == "cache_evict"]
+    assert len(evicts) == 2
+    assert all("op" in r and "n" in r and "dtype" in r for r in evicts)
 
 
 # ------------------------------------------------------ throughput acceptance

@@ -1,0 +1,601 @@
+"""Serve v2 gateway — continuous batching, tenant QoS, failover (ISSUE 7).
+
+Covers the three new serve modules bottom-up: the QoS primitives
+(token bucket refill, weighted-fair ordering, priority lanes, targeted
+eviction), the router (least-pending placement, watchdog-driven
+drain-to-sibling failover with futures intact), and the Gateway itself
+(sync + asyncio admission, continuous batching with linger, per-tenant
+quota/pending sheds, deadline-aware eviction that keeps expired requests
+away from dispatch, and the SLO roll-up emitted at close).  The failover
+acceptance test at the bottom reproduces the ISSUE scenario: a
+``testing.faults.hang``-wedged replica drains its queue to a sibling and
+every queued request completes or sheds with a typed error.
+"""
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu import resilience, serve, tune
+from dlaf_tpu.health import (
+    ConfigurationError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    DistributionError,
+    QueueFullError,
+    TenantQuotaExceededError,
+)
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve.qos import FairQueue, TenantConfig, TokenBucket
+from dlaf_tpu.serve.router import Replica, Router
+from dlaf_tpu.testing import faults
+
+
+@contextmanager
+def _tuned(**kw):
+    tune.initialize(**kw)
+    try:
+        yield
+    finally:
+        tune.initialize()
+
+
+def _spd(n, seed=0):
+    return tu.random_hermitian_pd(n, np.float32, seed=seed)
+
+
+def _gated_pool(**kw):
+    """Pool whose worker blocks before each dispatch until gate.set().
+
+    ``pool.at_gate`` is set once the worker is actually holding a batch at
+    the gate — tests that need "N in flight, M queued" wait on it instead
+    of guessing from queue depth."""
+    pool = serve.SolverPool(**kw)
+    gate = threading.Event()
+    pool.at_gate = threading.Event()
+    orig = pool._dispatch
+
+    def gated(key, reqs):
+        pool.at_gate.set()
+        gate.wait(60.0)
+        orig(key, reqs)
+
+    pool._dispatch = gated
+    return pool, gate
+
+
+class _AlwaysAlive(resilience.DeviceWatchdog):
+    """Per-replica liveness stub: models a mesh that is NOT affected by a
+    process-global fault injection (each real replica probes its own
+    devices; in one test process the injection hits every probe)."""
+
+    def probe(self, budget_s=None):
+        return 0.0
+
+
+# ------------------------------------------------------------------- QoS units
+
+
+def test_token_bucket_refill_and_burst():
+    tb = TokenBucket(rate=2.0, burst=3)
+    t0 = time.monotonic()
+    assert [tb.try_take(t0) for _ in range(4)] == [True, True, True, False]
+    # 1 second at rate 2 refills 2 tokens; burst clamps accumulation
+    assert tb.try_take(t0 + 1.0) and tb.try_take(t0 + 1.0)
+    assert not tb.try_take(t0 + 1.0)
+    tb2 = TokenBucket(rate=1.0, burst=2)
+    t1 = time.monotonic()
+    for _ in range(2):
+        tb2.try_take(t1)
+    assert tb2.try_take(t1 + 100.0)  # long idle: at most burst tokens
+    assert tb2.try_take(t1 + 100.0)
+    assert not tb2.try_take(t1 + 100.0)
+    # a backwards clock never drains the bucket
+    tb3 = TokenBucket(rate=1.0, burst=1)
+    assert tb3.try_take(time.monotonic() - 50.0)
+    # rate=None is unlimited
+    unlimited = TokenBucket(rate=None, burst=1)
+    assert all(unlimited.try_take() for _ in range(100))
+
+
+def test_fair_queue_weighted_fair_order():
+    fq = FairQueue()
+    heavy = TenantConfig("heavy", weight=2.0)
+    light = TenantConfig("light", weight=1.0)
+    for i in range(4):
+        fq.push(("heavy", i), heavy)
+    for i in range(4):
+        fq.push(("light", i), light)
+    order = [fq.pop() for _ in range(len(fq))]
+    # weight 2 drains twice as fast: in any prefix, heavy stays ~2x ahead
+    first_six = order[:6]
+    assert sum(1 for t, _ in first_six if t == "heavy") == 4
+    assert order[-2:] == [("light", 2), ("light", 3)]
+    assert fq.pop() is None
+
+
+def test_fair_queue_priority_lanes_strict():
+    fq = FairQueue()
+    lo = TenantConfig("lo", lane=2)
+    hi = TenantConfig("hi", lane=0)
+    fq.push("lo1", lo)
+    fq.push("lo2", lo)
+    fq.push("hi1", hi)
+    assert fq.pop() == "hi1"  # lane 0 preempts older lane-2 work
+    assert fq.pop() == "lo1"
+    assert len(fq) == 1
+
+
+def test_fair_queue_evict_worst_respects_max_lane():
+    fq = FairQueue()
+    hi = TenantConfig("hi", lane=0)
+    mid = TenantConfig("mid", lane=1)
+    lo = TenantConfig("lo", lane=2)
+    for item, cfg in (("h", hi), ("m", mid), ("l1", lo), ("l2", lo)):
+        fq.push(item, cfg)
+    # only lanes strictly below lane-1 urgency are eligible
+    assert fq.evict_worst(max_lane=1) == "l2"  # worst tag in worst lane
+    assert fq.evict_worst(max_lane=1) == "l1"
+    assert fq.evict_worst(max_lane=1) is None  # mid is a peer, not a victim
+    assert fq.evict_worst() == "m"  # unrestricted eviction
+    assert len(fq) == 1 and fq.pop() == "h"
+
+
+def test_fair_queue_remove_if():
+    fq = FairQueue()
+    cfg = TenantConfig("t")
+    for i in range(6):
+        fq.push(i, cfg)
+    removed = fq.remove_if(lambda i: i % 2 == 0)
+    assert sorted(removed) == [0, 2, 4]
+    assert len(fq) == 3
+    assert sorted(fq.drain()) == [1, 3, 5]
+
+
+def test_tenant_config_validation():
+    with pytest.raises(ConfigurationError, match="rate"):
+        TenantConfig("t", rate=0.0)
+    with pytest.raises(ConfigurationError, match="burst"):
+        TenantConfig("t", burst=0)
+    with pytest.raises(ConfigurationError, match="weight"):
+        TenantConfig("t", weight=-1.0)
+    with pytest.raises(ConfigurationError, match="lane"):
+        TenantConfig("t", lane=-1)
+    with pytest.raises(ConfigurationError, match="max_pending"):
+        TenantConfig("t", max_pending=0)
+
+
+# ---------------------------------------------------------------- router units
+
+
+def test_router_routes_least_pending_healthy():
+    with _tuned(serve_buckets="16"):
+        pa, gate_a = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        pb, gate_b = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        try:
+            router = Router([Replica("a", pa), Replica("b", pb)])
+            assert router.route().name in ("a", "b")
+            # load up a: three queued requests (worker gated)
+            for i in range(3):
+                pa.submit("potrf", "L", _spd(16, seed=i))
+            assert router.route().name == "b"
+            router.mark_down("b")
+            assert router.route().name == "a"
+            router.mark_down("a")
+            assert router.route() is None
+            router.revive("b")
+            assert router.route().name == "b"
+        finally:
+            gate_a.set()
+            gate_b.set()
+            pa.close()
+            pb.close()
+
+
+def test_router_validation():
+    with pytest.raises(DistributionError, match="at least one"):
+        Router([])
+    pool, gate = _gated_pool(cache=serve.CompiledCache())
+    try:
+        with pytest.raises(DistributionError, match="unique"):
+            Router([Replica("a", pool), Replica("a", pool)])
+        r = Router([Replica("a", pool)])
+        with pytest.raises(DistributionError, match="no replica"):
+            r.get("zz")
+    finally:
+        gate.set()
+        pool.close()
+
+
+def test_router_check_drains_wedged_replica_to_sibling():
+    """A replica whose probe exhausts under an injected hang is downed and
+    its queued requests are adopted by the sibling — the ORIGINAL futures
+    resolve from the sibling pool."""
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pa, gate_a = _gated_pool(block_size=8, max_batch=2, cache=cache)
+        pb = serve.SolverPool(block_size=8, max_batch=2, cache=cache)
+        try:
+            ra = Replica("a", pa, probe_budget_s=0.2)
+            rb = Replica("b", pb, watchdog=_AlwaysAlive())
+            router = Router([ra, rb])
+            ra.watchdog.probe()  # compile the probe kernel while healthy
+            futs = [pa.submit("potrf", "L", _spd(16, seed=i)) for i in range(4)]
+            # worker holds 2 at the gate; 2 remain queued in a
+            t0 = time.monotonic()
+            while pa.pending() > 2 and time.monotonic() - t0 < 10.0:
+                time.sleep(0.005)
+            with faults.hang(10.0):
+                summary = router.check()
+            assert summary["down"] == ["a"]
+            assert summary["migrated"] == 2 and summary["shed"] == 0
+            assert not ra.healthy and rb.healthy
+            # migrated futures complete on b while a's worker is still gated
+            for f in futs[2:]:
+                assert f.result(timeout=300).info == 0
+            gate_a.set()
+            for f in futs[:2]:
+                assert f.result(timeout=300).info == 0
+            # the next sweep (no hang) revives a
+            assert router.check()["revived"] == ["a"]
+            assert ra.healthy
+        finally:
+            gate_a.set()
+            pa.close()
+            pb.close()
+
+
+def test_router_sheds_typed_when_no_sibling_has_room():
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pa, gate_a = _gated_pool(block_size=8, max_batch=1, cache=cache)
+        pb, gate_b = _gated_pool(block_size=8, max_queue=1, cache=cache)
+        try:
+            ra = Replica("a", pa, probe_budget_s=0.2)
+            rb = Replica("b", pb, watchdog=_AlwaysAlive())
+            router = Router([ra, rb])
+            ra.watchdog.probe()
+            # fill b to capacity so it cannot adopt anything
+            fb = pb.submit("potrf", "L", _spd(16, seed=50))
+            t0 = time.monotonic()
+            while pb.pending() and time.monotonic() - t0 < 10.0:
+                time.sleep(0.005)
+            fb2 = pb.submit("potrf", "L", _spd(16, seed=51))
+            futs = [pa.submit("potrf", "L", _spd(16, seed=60 + i))
+                    for i in range(3)]
+            t0 = time.monotonic()
+            while pa.pending() > 2 and time.monotonic() - t0 < 10.0:
+                time.sleep(0.005)
+            with faults.hang(10.0):
+                summary = router.check()
+            assert summary["down"] == ["a"] and summary["shed"] == 2
+            shed = [f for f in futs if f.done() and f.exception() is not None]
+            assert len(shed) == 2
+            for f in shed:
+                assert isinstance(f.exception(), DeviceUnresponsiveError)
+            gate_a.set()
+            gate_b.set()
+            assert fb.result(300).info == 0 and fb2.result(300).info == 0
+        finally:
+            gate_a.set()
+            gate_b.set()
+            pa.close()
+            pb.close()
+
+
+# ------------------------------------------------------------------- gateway
+
+
+def test_gateway_end_to_end_mixed_tenants():
+    a = _spd(24, seed=1)
+    rhs = np.random.default_rng(2).standard_normal((24, 2)).astype(np.float32)
+    with _tuned(serve_buckets="24"):
+        with serve.SolverPool(block_size=8, cache=serve.CompiledCache()) as pool:
+            gw = serve.Gateway(
+                pool,
+                [TenantConfig("alpha", weight=2.0), TenantConfig("beta")],
+                max_batch=4, linger_ms=3.0,
+            )
+            try:
+                futs = [
+                    gw.submit_nowait("alpha", "potrf", "L", a),
+                    gw.submit_nowait("beta", "posv", "L", a, rhs),
+                    gw.submit_nowait("alpha", "posv", "L", a, rhs[:, 0]),
+                ]
+                r0 = futs[0].result(timeout=300)
+                low = np.tril(r0.x)
+                assert r0.info == 0 and np.abs(low @ low.T - a).max() < 1e-3
+                r1 = futs[1].result(timeout=300)
+                assert np.abs(a @ r1.x - rhs).max() < 1e-3
+                r2 = futs[2].result(timeout=300)
+                assert r2.x.shape == (24,)
+                st = gw.stats()
+                assert st["tenants"]["alpha"]["admitted"] == 2
+                assert st["tenants"]["beta"]["admitted"] == 1
+                assert st["tenants"]["alpha"]["done_ok"] == 2
+                assert st["dispatched"] == 3 and st["queued"] == 0
+                assert st["tenants"]["alpha"]["p50_s"] > 0
+            finally:
+                gw.close()
+
+
+def test_gateway_async_submit_gather():
+    import asyncio
+
+    a = _spd(16, seed=5)
+    with _tuned(serve_buckets="16"):
+        with serve.SolverPool(block_size=8, cache=serve.CompiledCache()) as pool:
+            with serve.Gateway(pool, [TenantConfig("t")], max_batch=4,
+                               linger_ms=2.0) as gw:
+
+                async def main():
+                    return await asyncio.gather(
+                        *[gw.submit("t", "potrf", "L", a) for _ in range(6)]
+                    )
+
+                results = asyncio.run(main())
+                assert len(results) == 6
+                assert all(r.info == 0 for r in results)
+
+
+def test_gateway_continuous_batching_rides_forming_batch():
+    """A request arriving during a compatible batch's linger window joins
+    it: two staggered submissions dispatch as ONE batch."""
+    a = _spd(16, seed=7)
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        # warm the executable so dispatch timing is solve-only
+        serve.batched_cholesky_factorization(
+            "L", a[None], block_size=8, shard_batch=True, cache=cache
+        )
+        with serve.SolverPool(block_size=8, cache=cache) as pool:
+            with serve.Gateway(pool, [TenantConfig("t")], max_batch=8,
+                               linger_ms=400.0) as gw:
+                f1 = gw.submit_nowait("t", "potrf", "L", a)
+                time.sleep(0.05)  # well inside the linger window
+                f2 = gw.submit_nowait("t", "potrf", "L", _spd(16, seed=8))
+                assert f1.result(timeout=300).info == 0
+                assert f2.result(timeout=300).info == 0
+                st = gw.stats()
+                assert st["batches"] == 1 and st["dispatched"] == 2
+                assert st["batch_fill"] == pytest.approx(2 / 8)
+
+
+def test_gateway_full_batch_preempts_linger():
+    """max_batch compatible requests dispatch immediately — the linger is
+    a deadline, not a delay."""
+    a = _spd(16, seed=9)
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        serve.batched_cholesky_factorization(
+            "L", np.stack([a, a]), block_size=8, shard_batch=True, cache=cache
+        )
+        with serve.SolverPool(block_size=8, max_batch=2, cache=cache) as pool:
+            with serve.Gateway(pool, [TenantConfig("t")], max_batch=2,
+                               linger_ms=20_000.0) as gw:
+                t0 = time.monotonic()
+                f1 = gw.submit_nowait("t", "potrf", "L", a)
+                f2 = gw.submit_nowait("t", "potrf", "L", _spd(16, seed=10))
+                assert f1.result(timeout=300).info == 0
+                assert f2.result(timeout=300).info == 0
+                assert time.monotonic() - t0 < 15.0  # did not wait out linger
+                assert gw.stats()["batch_fill"] == pytest.approx(1.0)
+
+
+def test_gateway_quota_shed_typed():
+    a = _spd(16, seed=11)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        try:
+            with serve.Gateway(
+                pool,
+                [TenantConfig("limited", rate=0.001, burst=1),
+                 TenantConfig("free")],
+                linger_ms=1.0,
+            ) as gw:
+                f1 = gw.submit_nowait("limited", "potrf", "L", a)
+                with pytest.raises(TenantQuotaExceededError) as exc:
+                    gw.submit_nowait("limited", "potrf", "L", a)
+                assert exc.value.tenant == "limited"
+                assert isinstance(exc.value, QueueFullError)  # taxonomy
+                # the quota is per tenant: others are unaffected
+                f2 = gw.submit_nowait("free", "potrf", "L", a)
+                gate.set()
+                assert f1.result(300).info == 0 and f2.result(300).info == 0
+                st = gw.stats()
+                assert st["tenants"]["limited"]["shed_quota"] == 1
+                assert st["tenants"]["free"]["shed_quota"] == 0
+        finally:
+            gate.set()
+            pool.close()
+
+
+def test_gateway_tenant_pending_bound():
+    a = _spd(16, seed=12)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        try:
+            with serve.Gateway(
+                pool, [TenantConfig("t", max_pending=1)], linger_ms=1.0
+            ) as gw:
+                f1 = gw.submit_nowait("t", "potrf", "L", a)
+                with pytest.raises(QueueFullError, match="pending"):
+                    gw.submit_nowait("t", "potrf", "L", a)
+                gate.set()
+                assert f1.result(300).info == 0
+                # the slot frees once the first request completes
+                f2 = gw.submit_nowait("t", "potrf", "L", a)
+                assert f2.result(300).info == 0
+        finally:
+            gate.set()
+            pool.close()
+
+
+def test_gateway_priority_eviction_under_overflow():
+    """A full gateway admits an urgent request by evicting the least
+    urgent strictly-lower-priority one (typed QueueFullError); peers
+    cannot evict each other."""
+    a = _spd(16, seed=13)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        try:
+            gw = serve.Gateway(
+                pool,
+                [TenantConfig("urgent", lane=0), TenantConfig("bulk", lane=2)],
+                max_queue=3, max_batch=8, linger_ms=60_000.0,
+            )
+            # linger 60s + gated pool: requests accumulate gateway-side
+            bulk = [gw.submit_nowait("bulk", "potrf", "L", a) for _ in range(3)]
+            with pytest.raises(QueueFullError):
+                gw.submit_nowait("bulk", "potrf", "L", a)  # peer: no eviction
+            urgent = gw.submit_nowait("urgent", "potrf", "L", a)
+            evicted = [f for f in bulk if f.done()]
+            assert len(evicted) == 1
+            assert isinstance(evicted[0].exception(), QueueFullError)
+            assert "higher-priority" in str(evicted[0].exception())
+            assert not urgent.done()
+            st = gw.stats()
+            assert st["tenants"]["bulk"]["evict_priority"] == 1
+            gate.set()
+            gw.close()  # flushes the lingering batch
+            assert urgent.result(300).info == 0
+            for f in bulk:
+                if f is not evicted[0]:
+                    assert f.result(300).info == 0
+        finally:
+            gate.set()
+            pool.close()
+
+
+def test_gateway_deadline_evicted_request_never_dispatched():
+    """ISSUE satellite: a request that expires gateway-side fails with
+    DeadlineExceededError and NEVER reaches any pool dispatch."""
+    a = _spd(16, seed=14)
+    with _tuned(serve_buckets="16"):
+        pool = serve.SolverPool(block_size=8, cache=serve.CompiledCache())
+        dispatched = []
+        orig = pool._dispatch
+
+        def recording(key, reqs):
+            dispatched.extend(id(r) for r in reqs)
+            orig(key, reqs)
+
+        pool._dispatch = recording
+        try:
+            with serve.Gateway(pool, [TenantConfig("t")], linger_ms=5.0) as gw:
+                f_dead = gw.submit_nowait("t", "potrf", "L", a, deadline_s=0.0)
+                f_live = gw.submit_nowait("t", "potrf", "L", a)
+                with pytest.raises(DeadlineExceededError):
+                    f_dead.result(timeout=300)
+                assert f_live.result(timeout=300).info == 0
+                st = gw.stats()
+                assert st["tenants"]["t"]["evict_deadline"] == 1
+            # exactly the live request reached a dispatch
+            assert len(dispatched) == 1
+        finally:
+            pool.close()
+
+
+def test_gateway_admission_validation():
+    a = _spd(16, seed=15)
+    with _tuned(serve_buckets="16"):
+        with serve.SolverPool(block_size=8, cache=serve.CompiledCache()) as pool:
+            with pytest.raises(ConfigurationError, match="at least one tenant"):
+                serve.Gateway(pool, [])
+            with pytest.raises(ConfigurationError, match="duplicate"):
+                serve.Gateway(pool, [TenantConfig("t"), TenantConfig("t")])
+            with pytest.raises(ConfigurationError, match="TenantConfig"):
+                serve.Gateway(pool, ["t"])
+            with pytest.raises(DistributionError, match="bounds"):
+                serve.Gateway(pool, [TenantConfig("t")], max_queue=0)
+            with serve.Gateway(pool, [TenantConfig("t")]) as gw:
+                with pytest.raises(ConfigurationError, match="unknown tenant"):
+                    gw.submit_nowait("nobody", "potrf", "L", a)
+                with pytest.raises(DistributionError, match="square"):
+                    gw.submit_nowait("t", "potrf", "L", a[:8])
+            with pytest.raises(DistributionError, match="closed"):
+                gw.submit_nowait("t", "potrf", "L", a)
+
+
+def test_gateway_close_emits_slo_rollup(tmp_path):
+    path = str(tmp_path / "gw_slo.jsonl")
+    a = _spd(16, seed=16)
+    om.enable(path)
+    try:
+        with _tuned(serve_buckets="16"):
+            with serve.SolverPool(block_size=8,
+                                  cache=serve.CompiledCache()) as pool:
+                gw = serve.Gateway(
+                    pool, [TenantConfig("x"), TenantConfig("y")],
+                    max_batch=4, linger_ms=2.0,
+                )
+                futs = [gw.submit_nowait("x", "potrf", "L", a),
+                        gw.submit_nowait("y", "potrf", "L", a)]
+                for f in futs:
+                    assert f.result(timeout=300).info == 0
+                gw.close()
+                gw.close()  # idempotent
+    finally:
+        om.close()
+    recs = [r for r in om.read_jsonl(path) if r["kind"] == "serve"]
+    slo = {r["tenant"]: r for r in recs if r["event"] == "gw_slo"}
+    assert set(slo) == {"x", "y"}
+    for r in slo.values():
+        assert r["done_ok"] == 1 and r["pending"] == 0
+        assert r["p50_s"] > 0 and r["p50_s"] <= r["p99_s"]
+    done = [r for r in recs if r["event"] == "gw_done"]
+    assert len(done) == 2 and all(r["outcome"] == "ok" for r in done)
+    assert any(r["event"] == "gw_batch" for r in recs)
+    assert any(r["event"] == "gw_summary" for r in recs)
+
+
+# -------------------------------------------------------- failover acceptance
+
+
+def test_gateway_failover_acceptance():
+    """ISSUE 7 acceptance: a fault-injected hang on one replica's mesh
+    drains its queue to the sibling; every queued request completes or
+    sheds with a typed error, and the gateway keeps serving."""
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pa, gate_a = _gated_pool(block_size=8, max_batch=2, cache=cache)
+        pb = serve.SolverPool(block_size=8, max_batch=2, cache=cache)
+        try:
+            ra = Replica("a", pa, probe_budget_s=0.2)
+            rb = Replica("b", pb, watchdog=_AlwaysAlive())
+            router = Router([ra, rb])
+            ra.watchdog.probe()  # pre-compile the probe kernel
+            router.mark_down("b")  # route the initial burst onto a
+            gw = serve.Gateway(router, [TenantConfig("t")], max_batch=2,
+                               linger_ms=2.0)
+            futs = [gw.submit_nowait("t", "potrf", "L", _spd(16, seed=20 + i))
+                    for i in range(6)]
+            # a's worker holds one batch of 2 at the gate; 4 queued behind it
+            assert pa.at_gate.wait(10.0)
+            t0 = time.monotonic()
+            while pa.pending() < 4 and time.monotonic() - t0 < 10.0:
+                time.sleep(0.005)
+            assert pa.pending() == 4
+            router.revive("b")
+            with faults.hang(10.0):
+                summary = gw.check_replicas()
+            assert summary["down"] == ["a"]
+            assert summary["migrated"] == 4 and summary["shed"] == 0
+            # migrated requests complete on b with their original futures
+            for f in futs[2:]:
+                assert f.result(timeout=300).info == 0
+            # new traffic routes to the healthy sibling
+            f_new = gw.submit_nowait("t", "potrf", "L", _spd(16, seed=30))
+            assert f_new.result(timeout=300).info == 0
+            # releasing the gate lets a's in-flight batch land too
+            gate_a.set()
+            for f in futs[:2]:
+                assert f.result(timeout=300).info == 0
+            gw.close()
+        finally:
+            gate_a.set()
+            pa.close()
+            pb.close()
