@@ -157,10 +157,6 @@ class Gateway:
             if self._closed:
                 raise DistributionError("serve: gateway is closed")
             c = self._counters[tenant]
-            if not self._buckets[tenant].try_take():
-                c["shed_quota"] += 1
-                om.emit("serve", event="gw_shed_quota", tenant=tenant, op=kind)
-                raise TenantQuotaExceededError(tenant, cfg.rate or 0.0)
             if cfg.max_pending is not None and self._pending[tenant] >= cfg.max_pending:
                 c["shed_full"] += 1
                 om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
@@ -172,9 +168,17 @@ class Gateway:
                         f"requests at its bound {cfg.max_pending}"
                     ),
                 )
+            if not self._buckets[tenant].try_take():
+                c["shed_quota"] += 1
+                om.emit("serve", event="gw_shed_quota", tenant=tenant, op=kind)
+                raise TenantQuotaExceededError(tenant, cfg.rate or 0.0)
             if self._queued_locked() >= self.max_queue:
                 self._make_room_locked(cfg)
             if self._queued_locked() >= self.max_queue:
+                # shed, not served: a request the gateway refuses must not
+                # consume the tenant's quota, or backpressure converts into
+                # quota starvation once capacity frees up
+                self._buckets[tenant].put_back()
                 c["shed_full"] += 1
                 om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
                         scope="gateway")
@@ -331,6 +335,14 @@ class Gateway:
         # pop in WFQ service order into per-group forming batches; a full
         # batch flushes immediately, everything else waits out its linger
         while len(self._fq):
+            # a flush can saturate the backend (or find every mesh down)
+            # and requeue its overflow right back into _fq — re-check the
+            # hold against a FRESH clock every iteration and bail out, so
+            # the lock releases and the pool's done-callbacks (which block
+            # on it) can drain; popping again here would spin forever
+            now = time.monotonic()
+            if now < self._hold_until:
+                return
             req, cfg = self._fq.pop()
             if req.expiry is not None and req.expiry <= now:
                 self._evict_locked(req, cfg, reason="deadline", where="queued")
@@ -345,9 +357,13 @@ class Gateway:
             self._forming_n += 1
             if len(fb["pairs"]) >= self.max_batch:
                 self._flush_locked(key, now)
+        now = time.monotonic()
+        if now < self._hold_until:
+            return
         for key in [k for k, fb in self._forming.items()
                     if fb["t_flush"] <= now or self._closed]:
-            if key in self._forming:
+            # a flush in this very loop may set the hold too
+            if key in self._forming and now >= self._hold_until:
                 self._flush_locked(key, now)
 
     def _flush_locked(self, key, now: float) -> None:

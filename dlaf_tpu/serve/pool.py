@@ -324,7 +324,6 @@ class SolverPool:
         budgets = [r.remaining() for r in reqs if r.expiry is not None]
         seconds = min(budgets) if budgets else None
         cold = key not in self._warm
-        self._warm.add(key)
         if cold and seconds is not None and self.compile_grace_s > 0:
             # first dispatch of this group: the bucket executable compiles
             # inside the bounded call — budget that separately so the
@@ -365,6 +364,10 @@ class SolverPool:
                 if not r.future.cancelled():
                     r.future.set_exception(exc)
             return
+        # warm only on success: a cold dispatch that dies before (or
+        # during) the first compile leaves the group cold, so later
+        # requests still get the compile grace instead of being shed
+        self._warm.add(key)
         elapsed = time.monotonic() - t0
         om.emit("serve", event="batch", op=kind, bucket=str(bucket),
                 batch=len(reqs), seconds=elapsed)

@@ -101,6 +101,11 @@ class TokenBucket:
             return True
         return False
 
+    def put_back(self) -> None:
+        """Refund one token (the request it paid for was shed unserved)."""
+        if self.rate is not None:
+            self._tokens = min(self.burst, self._tokens + 1.0)
+
 
 class FairQueue:
     """Priority lanes + weighted-fair queueing of opaque items.

@@ -144,6 +144,15 @@ class Router:
                 migrated, shed = self._drain_to_sibling(rep)
                 summary["migrated"] += migrated
                 summary["shed"] += shed
+            elif not ok and rep.pending():
+                # still down from a prior sweep, yet holding work: route()
+                # and adopt() are not synchronized with this sweep, so a
+                # batch can land on a replica right after it was downed and
+                # drained — keep draining until the queue stays empty,
+                # otherwise those futures strand on the wedged pool forever
+                migrated, shed = self._drain_to_sibling(rep)
+                summary["migrated"] += migrated
+                summary["shed"] += shed
         return summary
 
     def _drain_to_sibling(self, downed: Replica) -> tuple:

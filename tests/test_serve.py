@@ -21,6 +21,7 @@ from dlaf_tpu.algorithms.cholesky import cholesky_factorization
 from dlaf_tpu.algorithms.solver import positive_definite_solver
 from dlaf_tpu.health import (
     DeadlineExceededError,
+    DeviceUnresponsiveError,
     DistributionError,
     QueueFullError,
 )
@@ -569,6 +570,38 @@ def test_pool_compile_grace_covers_cold_dispatch(tmp_path):
     assert len(grace) == 1
     assert grace[0]["op"] == "potrf" and grace[0]["grace_s"] == 120.0
     assert grace[0]["budget_s"] > 120.0
+
+
+def test_pool_failed_cold_dispatch_keeps_group_cold(monkeypatch):
+    """REVIEW regression: a cold dispatch that dies before its compile
+    lands must NOT mark the group warm — the next request of that group
+    still gets the compile grace instead of re-creating the cold-replica
+    shedding the knob exists to fix."""
+    from dlaf_tpu.serve import batched
+
+    a = tu.random_hermitian_pd(16, np.float32, seed=502)
+    calls = {"n": 0}
+    real = batched.batched_cholesky_factorization
+
+    def flaky(*args, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise DeviceUnresponsiveError(
+                message="injected transient fault before first compile"
+            )
+        return real(*args, **kw)
+
+    monkeypatch.setattr(batched, "batched_cholesky_factorization", flaky)
+    with _tuned(serve_buckets="16", serve_compile_grace_s=120.0):
+        with serve.SolverPool(block_size=8, cache=serve.CompiledCache()) as pool:
+            f1 = pool.submit("potrf", "L", a, deadline_s=1.0)
+            with pytest.raises(DeviceUnresponsiveError):
+                pool.result(f1, 300)
+            # still cold: a budget far too small for any compile completes
+            # only because the grace applies to this retry too
+            f2 = pool.submit("potrf", "L", a, deadline_s=0.05)
+            assert pool.result(f2, 300).info == 0
+            assert calls["n"] == 2
 
 
 def test_pool_no_grace_sheds_cold_expired():

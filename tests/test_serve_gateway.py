@@ -286,6 +286,47 @@ def test_router_sheds_typed_when_no_sibling_has_room():
             pb.close()
 
 
+def test_router_redrains_replica_that_stays_down():
+    """Work adopted onto a replica AFTER its down-transition drain (the
+    route()/check() race) is migrated on the next sweep, not stranded —
+    check() drains any down replica with pending work, not only the
+    healthy->down edge."""
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        pa, gate_a = _gated_pool(block_size=8, max_batch=1, cache=cache)
+        pb = serve.SolverPool(block_size=8, max_batch=2, cache=cache)
+        try:
+            ra = Replica("a", pa, probe_budget_s=0.2)
+            rb = Replica("b", pb, watchdog=_AlwaysAlive())
+            router = Router([ra, rb])
+            ra.watchdog.probe()  # compile the probe kernel while healthy
+            # park a's worker at the gate so later adoptions stay QUEUED
+            f0 = pa.submit("potrf", "L", _spd(16, seed=69))
+            assert pa.at_gate.wait(60.0)
+            with faults.hang(10.0):
+                summary = router.check()
+            assert summary["down"] == ["a"] and summary["migrated"] == 0
+            assert not ra.healthy
+            # the race: a dispatcher adopts onto a after the drain ran
+            reqs = [serve.make_request("potrf", "L", _spd(16, seed=70 + i))
+                    for i in range(2)]
+            assert pa.adopt(reqs) == []
+            assert pa.pending() == 2
+            with faults.hang(10.0):
+                summary = router.check()
+            # not a transition (down stays down) — but the queue must move
+            assert summary["down"] == [] and summary["migrated"] == 2
+            assert pa.pending() == 0
+            for req in reqs:
+                assert req.future.result(timeout=300).info == 0
+            gate_a.set()
+            assert f0.result(timeout=300).info == 0
+        finally:
+            gate_a.set()
+            pa.close()
+            pb.close()
+
+
 # ------------------------------------------------------------------- gateway
 
 
@@ -465,6 +506,69 @@ def test_gateway_priority_eviction_under_overflow():
             for f in bulk:
                 if f is not evicted[0]:
                     assert f.result(300).info == 0
+        finally:
+            gate.set()
+            pool.close()
+
+
+def test_gateway_backend_saturation_holds_instead_of_livelock():
+    """REVIEW regression: with the backend pool full and >= max_batch
+    same-group requests queued, every flush overflows and requeues; the
+    dispatcher must back off and RELEASE its lock (gw_hold), not spin
+    re-forming the same batch while holding it — that spin deadlocked
+    the pool done-callbacks (which take the same lock), stats and close."""
+    with _tuned(serve_buckets="16"):
+        cache = serve.CompiledCache()
+        a = _spd(16, seed=20)
+        serve.batched_cholesky_factorization(
+            "L", np.stack([a]), block_size=8, shard_batch=True, cache=cache
+        )
+        pool, gate = _gated_pool(block_size=8, max_queue=1, max_batch=1,
+                                 cache=cache)
+        try:
+            with serve.Gateway(pool, [TenantConfig("t")], max_queue=32,
+                               max_batch=2, linger_ms=1.0) as gw:
+                futs = [gw.submit_nowait("t", "potrf", "L", _spd(16, seed=20 + i))
+                        for i in range(6)]
+                # the worker parks one batch at the gate and the pool queue
+                # (depth 1) fills: every gateway flush now overflows
+                assert pool.at_gate.wait(60.0)
+                time.sleep(0.3)  # let the dispatcher hit the saturated path
+                # the gateway lock must be acquirable: a livelocked pump
+                # would hang this stats() call forever
+                assert gw.stats()["tenants"]["t"]["admitted"] == 6
+                gate.set()
+                for f in futs:
+                    assert f.result(timeout=300).info == 0
+        finally:
+            gate.set()
+            pool.close()
+
+
+def test_gateway_queue_full_shed_does_not_burn_quota():
+    """REVIEW regression: a request shed with gateway-queue-full must not
+    consume the tenant's token bucket (pending/queue checks run before
+    the quota debit; the gateway-full path refunds), or backpressure
+    burns the bucket on rejections and quota-sheds once capacity frees."""
+    a = _spd(16, seed=21)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        try:
+            with serve.Gateway(
+                pool, [TenantConfig("t", rate=0.001, burst=2)],
+                max_queue=1, max_batch=8, linger_ms=60_000.0,
+            ) as gw:
+                f1 = gw.submit_nowait("t", "potrf", "L", a)  # fills the queue
+                for _ in range(3):  # would exhaust burst=2 without the refund
+                    with pytest.raises(QueueFullError) as exc:
+                        gw.submit_nowait("t", "potrf", "L", a)
+                    assert not isinstance(exc.value, TenantQuotaExceededError)
+                st = gw.stats()
+                assert st["tenants"]["t"]["shed_quota"] == 0
+                assert st["tenants"]["t"]["shed_full"] == 3
+                gate.set()
+                gw.close()  # flushes the lingering request
+                assert f1.result(timeout=300).info == 0
         finally:
             gate.set()
             pool.close()
