@@ -1,0 +1,99 @@
+"""CLI for the dlaf_tpu static-analysis pass.
+
+Usage::
+
+    python -m dlaf_tpu.analysis [paths ...]
+        [--format human|json] [--output FILE]
+        [--baseline FILE | --no-baseline] [--write-baseline]
+        [--rules DLAF001,DLAF004] [--list-rules]
+
+Defaults: paths = ``dlaf_tpu scripts`` relative to the repo root (the
+directory containing the ``dlaf_tpu`` package), baseline =
+``analysis_baseline.json`` at that root when present.  Exit status: 0
+when every active finding is in the baseline, 1 otherwise, 2 on usage
+errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from dlaf_tpu.analysis import engine
+
+
+def repo_root() -> str:
+    """Directory containing the ``dlaf_tpu`` package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlaf_tpu.analysis",
+        description="SPMD/trace-safety linter for the dlaf_tpu tree "
+                    "(DLAF001 cache keys, DLAF002 collective symmetry, "
+                    "DLAF003 trace purity, DLAF004 serve lock discipline).",
+    )
+    ap.add_argument("paths", nargs="*", help="files/directories to lint "
+                    "(default: dlaf_tpu scripts under the repo root)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--output", help="write the report here instead of stdout")
+    ap.add_argument("--baseline", help="baseline file (default: "
+                    f"{engine.BASELINE_NAME} at the repo root, if present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: every finding fails the run")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--rules", help="comma-separated rule ids to run")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = engine.all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.RULE}  {r.SUMMARY}")
+        return 0
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - {r.RULE for r in rules}
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.RULE in wanted]
+
+    root = repo_root()
+    paths = args.paths or [
+        p for p in (os.path.join(root, "dlaf_tpu"), os.path.join(root, "scripts"))
+        if os.path.isdir(p)
+    ]
+    baseline_path = None
+    if not args.no_baseline:
+        baseline_path = args.baseline or os.path.join(root, engine.BASELINE_NAME)
+        if not os.path.exists(baseline_path) and not args.baseline:
+            baseline_path = None
+
+    result = engine.run(paths, root=root, rules=rules,
+                        baseline_path=baseline_path)
+
+    if args.write_baseline:
+        target = args.baseline or os.path.join(root, engine.BASELINE_NAME)
+        engine.write_baseline(target, result.findings)
+        print(f"wrote {len(result.findings)} finding identities to {target}")
+        return 0
+
+    if args.format == "json":
+        report = json.dumps(result.to_json(), indent=2, sort_keys=True) + "\n"
+    else:
+        report = engine.render_human(result) + "\n"
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(report)
+    else:
+        sys.stdout.write(report)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,276 @@
+"""Rule engine for the dlaf_tpu static-analysis pass.
+
+The engine owns everything rule-independent: loading and parsing the
+target files, the suppression-comment grammar, the checked-in baseline,
+and the two output formats.  Rules are modules exposing ``RULE`` (the id),
+``SUMMARY`` (one line for ``--list``) and ``check(project) -> [Finding]``;
+they operate on a shared :class:`~dlaf_tpu.analysis.project.Project`.
+
+Suppressions: ``# dlaf: ignore[DLAF001] one-line justification`` on the
+flagged line (or on a comment-only line directly above it) silences that
+rule there.  Several rules separate with commas.  Suppressed findings are
+still collected and reported (``suppressed`` in JSON, a count in the
+human summary) so lint debt stays visible in ``report_metrics.py``.
+
+Baseline: ``analysis_baseline.json`` holds finding identities (rule, file,
+symbol, message — line numbers excluded, so pure line drift never breaks
+CI).  A run fails only on findings outside the baseline; baseline entries
+that no longer fire are reported as stale so the file ratchets down.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import asdict, dataclass, field
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dlaf:\s*ignore\[([A-Za-z0-9_,\s]+)\]\s*(.*)$"
+)
+
+BASELINE_NAME = "analysis_baseline.json"
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    symbol: str = ""   # enclosing function qualname, when known
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    @property
+    def identity(self) -> str:
+        """Line-independent identity used for baseline matching."""
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{sym}"
+
+
+@dataclass
+class SourceFile:
+    path: str                  # absolute (or virtual for in-memory sources)
+    rel: str                   # display/relative path
+    module: str                # dotted module name
+    text: str
+    tree: ast.AST = None
+    suppressions: dict = field(default_factory=dict)  # line -> (rules, reason)
+
+    @classmethod
+    def from_text(cls, path: str, rel: str, text: str) -> "SourceFile":
+        f = cls(path=path, rel=rel, module=module_name(rel), text=text)
+        f.tree = ast.parse(text, filename=rel)
+        f.suppressions = parse_suppressions(text)
+        return f
+
+    def line_text(self, line: int) -> str:
+        lines = self.text.splitlines()
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+
+
+def module_name(rel: str) -> str:
+    """Dotted module for a repo-relative path (``dlaf_tpu``-rooted when the
+    path contains the package, else path-derived)."""
+    parts = rel.replace("\\", "/").split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if "dlaf_tpu" in parts:
+        parts = parts[parts.index("dlaf_tpu"):]
+    return ".".join(p for p in parts if p) or "__main__"
+
+
+def parse_suppressions(text: str) -> dict:
+    """line -> (frozenset of rule ids, reason).  A suppression on a
+    comment-only line also covers the next non-blank line."""
+    out: dict[int, tuple] = {}
+    lines = text.splitlines()
+    for i, line in enumerate(lines, start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip()
+        )
+        reason = m.group(2).strip()
+        out[i] = (rules, reason)
+        if line.lstrip().startswith("#"):  # standalone: applies to next code line
+            for j in range(i + 1, len(lines) + 1):
+                if j > len(lines):
+                    break
+                nxt = lines[j - 1].strip()
+                if nxt and not nxt.startswith("#"):
+                    prev = out.get(j)
+                    if prev:
+                        out[j] = (prev[0] | rules, prev[1] or reason)
+                    else:
+                        out[j] = (rules, reason)
+                    break
+    return out
+
+
+# ------------------------------------------------------------------ loading
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def load_files(paths, root: str | None = None):
+    """(files, errors): parse every .py under ``paths``.  Unparseable files
+    become DLAF000 findings rather than crashing the run."""
+    root = os.path.abspath(root or os.getcwd())
+    files, errors = [], []
+    for path in iter_py_files(paths):
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        try:
+            with open(apath, encoding="utf-8") as fh:
+                text = fh.read()
+            files.append(SourceFile.from_text(apath, rel, text))
+        except (OSError, SyntaxError) as e:
+            line = getattr(e, "lineno", 0) or 0
+            errors.append(Finding(
+                rule="DLAF000", path=rel, line=line, col=0,
+                message=f"could not parse: {type(e).__name__}: {e}",
+            ))
+    return files, errors
+
+
+# ---------------------------------------------------------------- execution
+
+
+def all_rules():
+    from dlaf_tpu.analysis.rules import RULES
+
+    return list(RULES)
+
+
+@dataclass
+class Result:
+    findings: list          # active (non-suppressed, possibly baselined)
+    suppressed: list
+    new: list               # active findings outside the baseline
+    stale_baseline: list    # baseline identities that no longer fire
+    files: int
+    rule_ids: list
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def to_json(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "tool": "dlaf_tpu.analysis",
+            "schema": 1,
+            "files": self.files,
+            "rules": self.rule_ids,
+            "findings": [asdict(f) for f in self.findings],
+            "suppressed": [asdict(f) for f in self.suppressed],
+            "new": [asdict(f) for f in self.new],
+            "stale_baseline": list(self.stale_baseline),
+            "counts_by_rule": counts,
+            "ok": self.ok,
+        }
+
+
+def apply_suppressions(findings, files_by_rel):
+    """Split raw findings into (active, suppressed)."""
+    active, suppressed = [], []
+    for f in findings:
+        sf = files_by_rel.get(f.path)
+        hit = None
+        if sf is not None:
+            hit = sf.suppressions.get(f.line)
+        if hit and f.rule in hit[0]:
+            f.suppressed, f.suppress_reason = True, hit[1]
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def load_baseline(path: str | None):
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings) -> None:
+    data = {
+        "tool": "dlaf_tpu.analysis",
+        "schema": 1,
+        "findings": sorted(f.identity for f in findings),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def run(paths, *, root=None, rules=None, baseline_path=None):
+    """Load, index, run every rule, fold in suppressions and baseline."""
+    from dlaf_tpu.analysis.project import Project
+
+    files, errors = load_files(paths, root=root)
+    project = Project(files)
+    project.index()
+    rules = rules if rules is not None else all_rules()
+    raw = list(errors)
+    for rule in rules:
+        raw.extend(rule.check(project))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    by_rel = {f.rel: f for f in files}
+    active, suppressed = apply_suppressions(raw, by_rel)
+    baseline = load_baseline(baseline_path)
+    new = [f for f in active if f.identity not in baseline]
+    fired = {f.identity for f in active}
+    stale = sorted(baseline - fired)
+    return Result(
+        findings=active, suppressed=suppressed, new=new,
+        stale_baseline=stale, files=len(files),
+        rule_ids=[r.RULE for r in rules],
+    )
+
+
+def render_human(result: Result) -> str:
+    new_ids = {f.identity for f in result.new}
+    out = []
+    for f in result.findings:
+        mark = "" if f.identity in new_ids else "  (baselined)"
+        out.append(f.render() + mark)
+    if result.stale_baseline:
+        out.append("")
+        out.append(f"stale baseline entries ({len(result.stale_baseline)}) — "
+                   f"remove from {BASELINE_NAME}:")
+        out.extend(f"  {s}" for s in result.stale_baseline)
+    out.append("")
+    out.append(
+        f"{result.files} files, {len(result.findings)} findings "
+        f"({len(result.new)} new, "
+        f"{len(result.findings) - len(result.new)} baselined), "
+        f"{len(result.suppressed)} suppressed"
+    )
+    return "\n".join(out)

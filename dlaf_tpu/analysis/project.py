@@ -1,0 +1,354 @@
+"""Whole-program index shared by the analysis rules.
+
+The linter's rules are interprocedural: a ``tune`` knob read three calls
+below a compiled-kernel builder still has to surface in that builder's
+cache key (DLAF001), and a function whose *name* ends in ``_locked`` is
+part of a lock-held region even though the ``with self._cond`` sits in its
+caller (DLAF004).  This module builds the shared substrate once per run:
+
+* per-module import tables and top-level definitions,
+* a best-effort call/reference graph over dotted names (``coll.bcast``,
+  ``self._flush``, bare kernel references passed through ``partial`` or
+  kernel-dispatch dict literals),
+* the ``tune.TuneParameters`` knob registry (parsed from the dataclass
+  fields, never imported — the linter must run without JAX present), and
+* a fixpoint ``transitive_knobs`` provenance map: for every function, the
+  set of knobs readable at trace time from its body or anything it calls.
+
+Everything is deliberately approximate in the *safe* direction for the
+rules built on top: unresolvable calls contribute nothing (a missed read
+is a missed finding, never a false one), and the knob-coverage side of
+DLAF001 resolves names through local assignments so derived key elements
+(``ratio = _spmd.bucket_ratio()``) count as coverage.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+#: Calls whose transitive knob reads are NOT trace-state for cache keying.
+#: ``blas3_precision``/``matmul_precision`` apply a jax.default_matmul_precision
+#: context — jit itself keys on that context, so a key omitting the knob can
+#: never return a stale executable.  ``initialize``/``config_snapshot``/
+#: ``print_config`` touch every field by construction (config plumbing, not
+#: trace reads).
+KNOWN_SAFE_CALLEES = frozenset({
+    "blas3_precision",
+    "matmul_precision",
+    "initialize",
+    "config_snapshot",
+    "print_config",
+    "maybe_dump",        # debug HDF5 dumps: host-side, gated on debug_dump_*
+    "default_cache",     # serve cache construction reads capacity, not trace state
+})
+
+GTP_NAMES = frozenset({"get_tune_parameters", "_gtp"})
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_gtp_call(node: ast.AST) -> bool:
+    """A call that returns the live TuneParameters singleton."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name is not None and name.split(".")[-1] in GTP_NAMES
+
+
+@dataclass
+class FuncInfo:
+    """One analyzed function (methods and module-level defs alike).
+
+    Nested defs/lambdas are folded into their enclosing top-level function
+    or method: for knob provenance a closure's reads belong to whoever
+    builds and hands it to ``jit``/``shard_map``.
+    """
+
+    qualname: str            # "pkg.module:Class.method" / "pkg.module:func"
+    module: str
+    node: ast.AST = None
+    path: str = ""
+    calls: set = field(default_factory=set)       # resolved "module:func" targets
+    direct_knobs: dict = field(default_factory=dict)   # knob -> first read line
+
+
+class Project:
+    """Parsed files plus the lazily-built whole-program indexes."""
+
+    def __init__(self, files):
+        self.files = list(files)                  # engine.SourceFile list
+        self.by_module = {f.module: f for f in self.files}
+        self._indexed = False
+        self.functions: dict[str, FuncInfo] = {}
+        self.knob_registry: frozenset = frozenset()
+        self._imports: dict[str, dict] = {}       # module -> alias -> target
+        self._toplevel: dict[str, dict] = {}      # module -> name -> kind/info
+        self._knob_memo: dict[str, frozenset] = {}
+
+    # ------------------------------------------------------------- indexing
+
+    def index(self) -> "Project":
+        if self._indexed:
+            return self
+        self._indexed = True
+        self.knob_registry = self._load_knob_registry()
+        for f in self.files:
+            self._index_module(f)
+        for f in self.files:
+            self._index_functions(f)
+        return self
+
+    def _load_knob_registry(self) -> frozenset:
+        """Field names of ``tune.TuneParameters`` — the knob universe."""
+        tree = None
+        tf = self.by_module.get("dlaf_tpu.tune")
+        if tf is not None:
+            tree = tf.tree
+        else:  # linting a subtree that doesn't include tune.py: use the real one
+            import os
+
+            path = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tune.py")
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read())
+            except OSError:
+                return frozenset()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "TuneParameters":
+                return frozenset(
+                    stmt.target.id
+                    for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)
+                )
+        return frozenset()
+
+    def _index_module(self, f) -> None:
+        imports: dict[str, str] = {}
+        toplevel: dict[str, tuple] = {}
+        for node in f.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    up = f.module.split(".")
+                    up = up[: len(up) - node.level]
+                    base = ".".join(up + ([node.module] if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    imports[alias.asname or alias.name] = f"{base}.{alias.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                toplevel[node.name] = ("func", node.name)
+            elif isinstance(node, ast.ClassDef):
+                toplevel[node.name] = ("class", node.name)
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        toplevel.setdefault(
+                            f"{node.name}.{meth.name}", ("func", f"{node.name}.{meth.name}")
+                        )
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+                # kernel-dispatch tables: _CHOL_KERNELS = {"bucketed": fn, ...}
+                refs = [dotted_name(v) for v in node.value.values]
+                refs = [r for r in refs if r]
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and refs:
+                        toplevel[tgt.id] = ("dict", tuple(refs))
+        self._imports[f.module] = imports
+        self._toplevel[f.module] = toplevel
+
+    def _index_functions(self, f) -> None:
+        for node in f.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(f, node, None)
+            elif isinstance(node, ast.ClassDef):
+                for meth in node.body:
+                    if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._add_function(f, meth, node.name)
+
+    def _add_function(self, f, node, class_name) -> None:
+        local = f"{class_name}.{node.name}" if class_name else node.name
+        qn = f"{f.module}:{local}"
+        info = FuncInfo(qualname=qn, module=f.module, node=node, path=f.rel)
+        self.functions[qn] = info
+        gtp_aliases = {
+            tgt.id
+            for sub in ast.walk(node)
+            if isinstance(sub, ast.Assign) and _is_gtp_call(sub.value)
+            for tgt in sub.targets
+            if isinstance(tgt, ast.Name)
+        }
+        for sub in ast.walk(node):
+            knob, line = self._knob_read(sub, gtp_aliases)
+            if knob is not None:
+                info.direct_knobs.setdefault(knob, line)
+            if isinstance(sub, ast.Call):
+                tgt = self.resolve_call(f.module, class_name, sub.func)
+                if tgt:
+                    info.calls.add(tgt)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                tgt = self.resolve_name(f.module, class_name, sub.id)
+                if tgt:
+                    info.calls.add(tgt)
+
+    def _knob_read(self, node, gtp_aliases) -> tuple:
+        """(knob, line) when ``node`` reads a tune knob, else (None, 0).
+
+        Recognizes ``get_tune_parameters().k``, ``p.k`` for a local alias
+        ``p = get_tune_parameters()``, and ``getattr(<either>, "k", d)``.
+        """
+        reg = self.knob_registry
+        if isinstance(node, ast.Attribute) and node.attr in reg:
+            recv = node.value
+            if _is_gtp_call(recv) or (
+                isinstance(recv, ast.Name) and recv.id in gtp_aliases
+            ):
+                return node.attr, node.lineno
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+            and node.args[1].value in reg
+        ):
+            recv = node.args[0]
+            if _is_gtp_call(recv) or (
+                isinstance(recv, ast.Name) and recv.id in gtp_aliases
+            ):
+                return node.args[1].value, node.lineno
+        return None, 0
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve_call(self, module, class_name, func_expr) -> str | None:
+        name = dotted_name(func_expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and class_name and len(parts) == 2:
+            qn = f"{module}:{class_name}.{parts[1]}"
+            return qn if qn in self.functions else None
+        return self._resolve_dotted(module, parts)
+
+    def resolve_name(self, module, class_name, name) -> str | None:
+        return self._resolve_dotted(module, [name])
+
+    def _resolve_dotted(self, module, parts) -> str | None:
+        imports = self._imports.get(module, {})
+        toplevel = self._toplevel.get(module, {})
+        head = parts[0]
+        if head in toplevel and len(parts) == 1:
+            kind, val = toplevel[head]
+            if kind == "func":
+                return f"{module}:{val}"
+            if kind == "dict":
+                return f"{module}:#dict:{head}"
+            return None
+        if head in imports:
+            rest = parts[1:]
+            full = imports[head] + ("." + ".".join(rest) if rest else "")
+            # longest prefix of `full` that is a scanned module; remainder is
+            # the function (possibly Class.method) inside it
+            comps = full.split(".")
+            for cut in range(len(comps), 0, -1):
+                mod = ".".join(comps[:cut])
+                if mod in self.by_module:
+                    attr = ".".join(comps[cut:])
+                    if not attr:
+                        return None
+                    qn = f"{mod}:{attr}"
+                    if qn in self.functions:
+                        return qn
+                    tl = self._toplevel.get(mod, {})
+                    if attr in tl and tl[attr][0] == "dict":
+                        return f"{mod}:#dict:{attr}"
+                    # unknown attr of a known module: treat as opaque
+                    return qn if attr.split(".")[-1] in GTP_NAMES else None
+            return None
+        if len(parts) > 1:
+            # a.b.c with unknown head (e.g. method on an object): give up
+            return None
+        return None
+
+    def expand_target(self, target: str) -> set:
+        """Dispatch-dict pseudo-targets expand to their function values."""
+        if "#dict:" not in target:
+            return {target}
+        mod, name = target.split(":#dict:")
+        kind_val = self._toplevel.get(mod, {}).get(name)
+        out = set()
+        if kind_val and kind_val[0] == "dict":
+            for ref in kind_val[1]:
+                qn = self._resolve_dotted(mod, ref.split("."))
+                if qn:
+                    out.add(qn)
+        return out
+
+    # ------------------------------------------------------ knob provenance
+
+    def transitive_knobs(self, qualname: str) -> frozenset:
+        """Every knob readable from ``qualname`` or its transitive callees.
+
+        Calls on the KNOWN_SAFE_CALLEES list are pruned (see the constant's
+        comment); unresolved calls contribute nothing.
+        """
+        self.index()
+        memo = self._knob_memo
+        if qualname in memo:
+            return memo[qualname]
+        memo[qualname] = frozenset()  # cycle guard: fixpoint from below
+        result = set()
+        stack = [qualname]
+        seen = set()
+        while stack:
+            qn = stack.pop()
+            if qn in seen:
+                continue
+            seen.add(qn)
+            for target in list(self.expand_target(qn)):
+                if target.split(":")[-1].split(".")[-1] in KNOWN_SAFE_CALLEES:
+                    continue
+                info = self.functions.get(target)
+                if info is None:
+                    continue
+                result.update(info.direct_knobs)
+                stack.extend(info.calls - seen)
+        memo[qualname] = frozenset(result)
+        return memo[qualname]
+
+    def knob_witness(self, root: str, knob: str) -> tuple:
+        """(qualname, line) of one reachable direct read of ``knob``."""
+        self.index()
+        stack, seen = [root], set()
+        while stack:
+            qn = stack.pop()
+            if qn in seen:
+                continue
+            seen.add(qn)
+            for target in self.expand_target(qn):
+                if target.split(":")[-1].split(".")[-1] in KNOWN_SAFE_CALLEES:
+                    continue
+                info = self.functions.get(target)
+                if info is None:
+                    continue
+                if knob in info.direct_knobs:
+                    return target, info.direct_knobs[knob]
+                stack.extend(info.calls - seen)
+        return root, 0

@@ -1,0 +1,225 @@
+"""DLAF001 — compiled-kernel cache keys must cover every trace-time knob.
+
+The bug class (shipped twice before this linter existed: the round-4
+``bt_band_hh_group_size`` omission and the serve ``trsm_lookahead``
+omission fixed in this PR): a builder reads ``tune.<knob>`` while
+constructing or tracing a jitted kernel, stores the executable in a
+module-level dict cache or a serve ``CompiledCache``, but the cache key
+doesn't change when the knob does — so flipping the knob silently reuses
+the stale executable.  "A knob outside the key is a dead knob."
+
+Detection, per function ``F`` in the indexed project:
+
+* **dict-store form** — ``<something named *cache*>[key] = <expr>`` where
+  the stored value is an executable (Call/Name/Lambda, not a sentinel
+  constant).  Reads = every knob transitively reachable from ``F``
+  (builders are self-contained: kernels, trace-key helpers and the store
+  share one function).
+* **CompiledCache form** — ``<cache>.get(key, builder)`` with a callable
+  second argument.  Reads = the transitive knobs of the *builder* only
+  (the driver around it reads admission knobs — ``serve_cache_capacity``,
+  ``serve_buckets`` — that are deliberately not trace state).
+
+Coverage = knobs attributable to the key expression: direct reads in it,
+transitive knobs of functions it calls (``_spmd.trsm_trace_key()``,
+``coll.collectives_trace_key()``, ``_trace_knobs(...)``), and — resolved
+through local assignments — knobs behind derived elements such as
+``ratio = _spmd.bucket_ratio()`` or ``variant = _chol_variant()``.
+
+Anything read but not covered is a finding naming the knob and a witness
+read location.
+"""
+from __future__ import annotations
+
+import ast
+
+from dlaf_tpu.analysis.engine import Finding
+from dlaf_tpu.analysis.project import KNOWN_SAFE_CALLEES, dotted_name
+
+RULE = "DLAF001"
+SUMMARY = "trace-time tune knob read by a cached-kernel builder but missing from the cache key"
+
+_CACHE_NAME_HINT = "cache"
+
+
+def _expr_text(node) -> str:
+    name = dotted_name(node)
+    if name is not None:
+        return name
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on parsed trees
+        return ""
+
+
+def _is_cacheish(node) -> bool:
+    return _CACHE_NAME_HINT in _expr_text(node).lower()
+
+
+def _is_executable_value(node) -> bool:
+    """Stored values that can hold a compiled kernel (filters sentinels
+    like ``_local_cache[fail_key] = True``)."""
+    return isinstance(node, (ast.Call, ast.Name, ast.Lambda, ast.Attribute))
+
+
+class _KeyCoverage:
+    """Knobs attributable to a key expression inside one function."""
+
+    def __init__(self, project, module, class_name, func_node):
+        self.project = project
+        self.module = module
+        self.class_name = class_name
+        # name -> list of assignment value exprs within the function
+        self.assigns: dict[str, list] = {}
+        for sub in ast.walk(func_node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.assigns.setdefault(tgt.id, []).append(sub.value)
+                    elif isinstance(tgt, ast.Tuple):
+                        for el in tgt.elts:
+                            if isinstance(el, ast.Name):
+                                self.assigns.setdefault(el.id, []).append(sub.value)
+            elif isinstance(sub, ast.AugAssign) and isinstance(sub.target, ast.Name):
+                self.assigns.setdefault(sub.target.id, []).append(sub.value)
+
+    def knobs(self, expr, depth: int = 0, seen=None) -> set:
+        """Recursive knob attribution for one expression."""
+        if expr is None or depth > 6:
+            return set()
+        seen = set() if seen is None else seen
+        proj = self.project
+        out: set = set()
+        gtp_aliases = {
+            n for n, vals in self.assigns.items()
+            if any(_is_gtp(v) for v in vals)
+        }
+        for node in ast.walk(expr):
+            knob, _ = proj._knob_read(node, gtp_aliases)
+            if knob:
+                out.add(knob)
+            if isinstance(node, ast.Call):
+                tgt = proj.resolve_call(self.module, self.class_name, node.func)
+                if tgt:
+                    out |= proj.transitive_knobs(tgt)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in seen:
+                    continue
+                seen.add(node.id)
+                for val in self.assigns.get(node.id, []):
+                    out |= self.knobs(val, depth + 1, seen)
+        return out
+
+
+def _is_gtp(node) -> bool:
+    from dlaf_tpu.analysis.project import _is_gtp_call
+
+    return _is_gtp_call(node)
+
+
+def _builder_reads(project, info, builder_expr) -> dict:
+    """knob -> witness (qualname, line) for a CompiledCache builder arg."""
+    reads: dict = {}
+    module, class_name = info.module, _class_of(info)
+    targets = set()
+    if isinstance(builder_expr, ast.Lambda):
+        for sub in ast.walk(builder_expr.body):
+            if isinstance(sub, ast.Call):
+                tgt = project.resolve_call(module, class_name, sub.func)
+                if tgt:
+                    targets.add(tgt)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                tgt = project.resolve_name(module, class_name, sub.id)
+                if tgt:
+                    targets.add(tgt)
+    else:
+        tgt = project.resolve_call(module, class_name, builder_expr) \
+            if isinstance(builder_expr, ast.Call) else None
+        if tgt is None:
+            name = dotted_name(builder_expr)
+            if name:
+                tgt = project._resolve_dotted(module, name.split("."))
+        if tgt:
+            targets.add(tgt)
+    for tgt in targets:
+        if tgt.split(":")[-1].split(".")[-1] in KNOWN_SAFE_CALLEES:
+            continue
+        for knob in project.transitive_knobs(tgt):
+            if knob not in reads:
+                reads[knob] = project.knob_witness(tgt, knob)
+    return reads
+
+
+def _class_of(info):
+    local = info.qualname.split(":", 1)[1]
+    return local.split(".")[0] if "." in local else None
+
+
+def _key_expr_for(name_or_expr, cov):
+    """The tuple expression(s) behind a key operand."""
+    if isinstance(name_or_expr, ast.Name):
+        return cov.assigns.get(name_or_expr.id, [])
+    return [name_or_expr]
+
+
+def check(project):
+    findings = []
+    for info in project.functions.values():
+        file = project.by_module.get(info.module)
+        if file is None:
+            continue
+        class_name = _class_of(info)
+        cov = None
+        for sub in ast.walk(info.node):
+            # ---- dict-store form:  *cache*[key] = <executable>
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Subscript) \
+                    and _is_cacheish(sub.targets[0].value) \
+                    and _is_executable_value(sub.value):
+                cov = cov or _KeyCoverage(project, info.module, class_name, info.node)
+                reads = {
+                    k: project.knob_witness(info.qualname, k)
+                    for k in project.transitive_knobs(info.qualname)
+                }
+                key_node = sub.targets[0].slice
+                covered = set()
+                for expr in _key_expr_for(key_node, cov):
+                    covered |= cov.knobs(expr)
+                findings.extend(_report(
+                    project, file, info, sub, reads, covered,
+                    cache_name=_expr_text(sub.targets[0].value),
+                ))
+            # ---- CompiledCache form:  <cache>.get(key, builder)
+            elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "get" and len(sub.args) == 2 \
+                    and _is_cacheish(sub.func.value) \
+                    and isinstance(sub.args[1], (ast.Lambda, ast.Name, ast.Attribute)):
+                cov = cov or _KeyCoverage(project, info.module, class_name, info.node)
+                reads = _builder_reads(project, info, sub.args[1])
+                covered = set()
+                for expr in _key_expr_for(sub.args[0], cov):
+                    covered |= cov.knobs(expr)
+                findings.extend(_report(
+                    project, file, info, sub, reads, covered,
+                    cache_name=_expr_text(sub.func.value),
+                ))
+    return findings
+
+
+def _report(project, file, info, node, reads, covered, *, cache_name):
+    missing = sorted(set(reads) - covered)
+    if not missing:
+        return []
+    parts = []
+    for knob in missing:
+        wq, wl = reads[knob]
+        wfn = wq.split(":")[-1]
+        parts.append(f"{knob} (read in {wfn})")
+    return [Finding(
+        rule=RULE, path=file.rel, line=node.lineno, col=node.col_offset,
+        symbol=info.qualname.split(":")[-1],
+        message=(
+            f"cache '{cache_name}' key misses trace-time knob(s): "
+            + ", ".join(parts)
+        ),
+    )]

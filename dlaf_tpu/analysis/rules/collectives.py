@@ -1,0 +1,154 @@
+"""DLAF002 — collective symmetry: every rank must reach every collective.
+
+SPMD kernels over the ('r','c') mesh deadlock silently when a collective
+(`psum`, `ppermute`, `coll.bcast`, the transpose_panel family, the Pallas
+ring exchanges) executes on some ranks but not others.  The legal way to
+vary behavior by rank is *traced* control flow (``lax.cond``, masking,
+``jnp.where``) — every rank still issues the identical collective
+sequence.  The illegal way is Python ``if`` on a rank coordinate: the
+trace itself diverges per rank.  Tier-1's tiny meshes rarely trip this
+(the guarded branch often agrees across 2 ranks); a pod hangs.
+
+Two checks:
+
+* **rank-guarded collectives** — a Python ``if`` whose test involves a
+  rank-derived value (``lax.axis_index``, ``coll.my_rank``,
+  ``jax.process_index`` or a local name assigned from them) with a
+  collective call anywhere in either branch.
+
+* **Mosaic collective-id discipline** — ``pallas_call`` sites must not
+  pass a literal ``collective_id=<int>`` (two kernels sharing an id share
+  DMA semaphores: the shipped PR-6 bug), and ``dma_ring_exchange`` callers
+  must pass ``collective_id=...`` explicitly (the omitted default is the
+  shared id 0) — both must route through ``collective_id_for`` or the
+  module's reserved-id table.
+"""
+from __future__ import annotations
+
+import ast
+
+from dlaf_tpu.analysis.engine import Finding
+from dlaf_tpu.analysis.project import dotted_name
+
+RULE = "DLAF002"
+SUMMARY = "collective under rank-dependent Python control flow / raw Mosaic collective_id"
+
+#: Call names (last dotted component) that are cross-rank collectives.
+COLLECTIVE_NAMES = frozenset({
+    "psum", "ppermute", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "axis_index_groups",
+    # comm.collectives surface
+    "bcast", "bcast2d", "shift", "psum_axis", "all_gather_axis",
+    "transpose_panel", "transpose_panel_windowed", "transpose_panel_rows",
+    "transpose_panel_rows_windowed",
+    # pallas ring tier
+    "ring_exchange", "ring_bcast", "dma_ring_exchange",
+    "pallas_panel_exchange",
+})
+
+#: Calls that yield a per-rank coordinate at trace time.
+RANK_SOURCES = frozenset({"axis_index", "my_rank", "process_index"})
+
+
+def _last(name: str | None) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _rank_tainted_names(func_node) -> set:
+    """Local names holding rank coordinates (incl. tuple unpacking)."""
+    tainted: set = set()
+    for _ in range(2):  # one extra pass for simple taint chains (me = myr)
+        for sub in ast.walk(func_node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            src_tainted = any(
+                (isinstance(n, ast.Call) and _last(dotted_name(n.func)) in RANK_SOURCES)
+                or (isinstance(n, ast.Name) and n.id in tainted
+                    and isinstance(n.ctx, ast.Load))
+                for n in ast.walk(sub.value)
+            )
+            if not src_tainted:
+                continue
+            for tgt in sub.targets:
+                for el in ast.walk(tgt):
+                    if isinstance(el, ast.Name):
+                        tainted.add(el.id)
+    return tainted
+
+
+def _test_is_rank_dependent(test, tainted) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call) and _last(dotted_name(sub.func)) in RANK_SOURCES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _collectives_in(stmts):
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                name = _last(dotted_name(sub.func))
+                if name in COLLECTIVE_NAMES:
+                    yield sub, name
+
+
+def check(project):
+    findings = []
+    for info in project.functions.values():
+        file = project.by_module.get(info.module)
+        if file is None:
+            continue
+        tainted = _rank_tainted_names(info.node)
+        for sub in ast.walk(info.node):
+            if isinstance(sub, ast.If) and _test_is_rank_dependent(sub.test, tainted):
+                for call, name in _collectives_in(sub.body + sub.orelse):
+                    findings.append(Finding(
+                        rule=RULE, path=file.rel, line=call.lineno,
+                        col=call.col_offset,
+                        symbol=info.qualname.split(":")[-1],
+                        message=(
+                            f"collective '{name}' under a rank-dependent Python "
+                            f"'if' — ranks trace divergent collective sequences "
+                            f"(use lax.cond/masking so every rank issues it)"
+                        ),
+                    ))
+            elif isinstance(sub, ast.Call):
+                findings.extend(_check_collective_id(file, info, sub))
+    return findings
+
+
+#: dma_ring_exchange(yf, h, ring_axis, mesh_axes, interpret, collective_id)
+_DMA_RING_CID_POS = 5
+
+
+def _check_collective_id(file, info, call):
+    name = _last(dotted_name(call.func))
+    out = []
+    # the collective_id value, whether passed by keyword or (for
+    # dma_ring_exchange, whose signature we know) positionally
+    cid_values = [kw.value for kw in call.keywords if kw.arg == "collective_id"]
+    if name == "dma_ring_exchange" and len(call.args) > _DMA_RING_CID_POS:
+        cid_values.append(call.args[_DMA_RING_CID_POS])
+    for value in cid_values:
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            out.append(Finding(
+                rule=RULE, path=file.rel, line=call.lineno, col=call.col_offset,
+                symbol=info.qualname.split(":")[-1],
+                message=(
+                    f"literal Mosaic collective_id={value.value} — kernels "
+                    f"sharing an id share DMA semaphores; allocate through "
+                    f"collective_id_for() or the reserved-id table"
+                ),
+            ))
+    if name == "dma_ring_exchange" and not cid_values:
+        out.append(Finding(
+            rule=RULE, path=file.rel, line=call.lineno, col=call.col_offset,
+            symbol=info.qualname.split(":")[-1],
+            message=(
+                "dma_ring_exchange without an explicit collective_id — the "
+                "default is the shared id 0; pass collective_id_for(kind, axis)"
+            ),
+        ))
+    return out

@@ -1,0 +1,131 @@
+"""DLAF004 — serve lock discipline: no blocking work under a held lock.
+
+The serve layer's contract (pool.py / gateway.py / router.py /
+resilience.py): locks guard *state transitions*, never *work*.  Blocking
+under ``self._lock`` / ``self._cond`` is how the gateway livelock and the
+saturation deadlock shipped: pool dispatch (``adopt``/``drain``), future
+waits (``result``/``wait`` on a different primitive than the one held),
+``time.sleep`` and thread ``join`` all stall every other thread that
+needs the lock — including the pool done-callbacks that complete client
+futures.  Completing futures (``set_result``/``set_exception``) under a
+held lock is the subtler variant: done-callbacks run synchronously on the
+completing thread and re-enter whatever lock they like.
+
+Scope: files under ``serve/`` plus ``resilience.py`` (the rule is a
+*policy* for that layer, not a general theorem — kernel modules use no
+locks).  Lock-held regions are (a) ``with <lock-like>:`` bodies, where
+lock-like is an expression ending in ``lock``/``cond`` (any case), and
+(b) whole bodies of functions named ``*_locked`` — the repo's convention
+for "caller holds the lock".  ``<held>.wait()`` on the exact expression
+the ``with`` entered is the one legal blocking call (Condition.wait
+releases it); ``.wait()`` on anything else deadlocks or races.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from dlaf_tpu.analysis.engine import Finding
+from dlaf_tpu.analysis.project import dotted_name
+
+RULE = "DLAF004"
+SUMMARY = "blocking call / future completion while holding a serve-layer lock"
+
+LOCKISH_RE = re.compile(r"(lock|cond)$", re.IGNORECASE)
+
+#: attribute-call names that block (or synchronously run foreign code)
+BLOCKING_ATTRS = frozenset({
+    "result",        # Future.result
+    "join",          # Thread.join
+    "adopt", "drain",            # pool dispatch surface
+    "submit", "submit_nowait",   # pool/gateway admission (takes their locks)
+    "acquire",                   # nested lock acquisition
+})
+COMPLETION_ATTRS = frozenset({"set_result", "set_exception"})
+
+
+def in_scope(file) -> bool:
+    rel = file.rel.replace("\\", "/")
+    return "/serve/" in rel or rel.endswith("resilience.py") \
+        or rel.split("/")[-1] == "resilience.py"
+
+
+def _lock_expr_text(node) -> str | None:
+    name = dotted_name(node)
+    if name and LOCKISH_RE.search(name.rsplit(".", 1)[-1]):
+        return name
+    return None
+
+
+def _flag(findings, file, symbol, call, msg):
+    findings.append(Finding(
+        rule=RULE, path=file.rel, line=call.lineno, col=call.col_offset,
+        symbol=symbol, message=msg,
+    ))
+
+
+def _scan_stmts(findings, file, symbol, stmts, held: str):
+    """Walk statements with ``held`` (lock expr text, or "<caller>" for
+    ``*_locked`` functions) currently held."""
+    for stmt in stmts:
+        _scan_node(findings, file, symbol, stmt, held)
+
+
+def _scan_node(findings, file, symbol, node, held: str):
+    if isinstance(node, ast.With):
+        inner = held
+        for item in node.items:
+            t = _lock_expr_text(item.context_expr)
+            if t:
+                inner = t
+        _scan_stmts(findings, file, symbol, node.body, inner)
+        return
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        # a nested def under a with is *defined*, not run, under the lock
+        return
+    if isinstance(node, ast.Call):
+        _check_call(findings, file, symbol, node, held)
+    for child in ast.iter_child_nodes(node):
+        _scan_node(findings, file, symbol, child, held)
+
+
+def _check_call(findings, file, symbol, call, held: str):
+    if not held:
+        return
+    name = dotted_name(call.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    recv = name.rsplit(".", 1)[0] if "." in name else ""
+    if name == "time.sleep":
+        _flag(findings, file, symbol, call,
+              f"time.sleep while holding {held} — every thread needing the "
+              f"lock stalls for the whole sleep")
+    elif last == "wait" and isinstance(call.func, ast.Attribute):
+        if recv and recv != held and not (
+            held == "<caller>" and LOCKISH_RE.search(recv.rsplit(".", 1)[-1])
+        ):
+            _flag(findings, file, symbol, call,
+                  f"'{name}()' waits on a different primitive than the held "
+                  f"{held} — the held lock is NOT released while waiting "
+                  f"(deadlock with whoever needs it to signal)")
+    elif last in BLOCKING_ATTRS and isinstance(call.func, ast.Attribute):
+        _flag(findings, file, symbol, call,
+              f"blocking call '{name}()' while holding {held} — move the "
+              f"work outside the lock and re-acquire for the state update")
+    elif last in COMPLETION_ATTRS and isinstance(call.func, ast.Attribute):
+        _flag(findings, file, symbol, call,
+              f"'{name}()' completes a future while holding {held} — "
+              f"done-callbacks run synchronously on this thread and may "
+              f"re-enter the lock (or block on another)")
+
+
+def check(project):
+    findings = []
+    for info in project.functions.values():
+        file = project.by_module.get(info.module)
+        if file is None or not in_scope(file):
+            continue
+        symbol = info.qualname.split(":")[-1]
+        fname = info.qualname.rsplit(".", 1)[-1]
+        held = "<caller>" if fname.endswith("_locked") else ""
+        _scan_stmts(findings, file, symbol, info.node.body, held)
+    return findings

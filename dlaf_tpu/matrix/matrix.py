@@ -123,7 +123,8 @@ class DistributedMatrix:
                 shape,
                 sharding,
                 lambda idx: np.zeros(
-                    tuple(len(range(*s.indices(d))) for s, d in zip(idx, shape)),
+                    tuple(len(range(*s.indices(d)))
+                          for s, d in zip(idx, shape, strict=True)),
                     dtype=np.dtype(dtype),
                 ),
             )

@@ -163,7 +163,9 @@ def parse_spectrum(args) -> "tuple[int, int] | None":
     try:
         il, iu = (int(v) for v in args.spectrum.split(":"))
     except ValueError:
-        raise SystemExit(f"--spectrum must be IL:IU, got {args.spectrum!r}")
+        raise SystemExit(
+            f"--spectrum must be IL:IU, got {args.spectrum!r}"
+        ) from None
     if not (0 <= il <= iu < args.m):
         raise SystemExit(f"--spectrum {il}:{iu} outside [0, {args.m})")
     return (il, iu)

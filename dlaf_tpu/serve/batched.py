@@ -195,9 +195,15 @@ def _chol_variant() -> str:
 
 def _trace_knobs(variant: str) -> tuple:
     """Trace-time knobs every serve executable key must carry (the same
-    set the single drivers' kernel caches use)."""
+    set the single drivers' kernel caches use).  ``trsm_lookahead`` picks
+    the posv solve kernel inside `_build_posv_matrix_exec`; carrying it
+    for every op over-keys potrf/eigh harmlessly but keeps one knob tuple
+    for the whole serve tier (DLAF001)."""
+    from dlaf_tpu.tune import get_tune_parameters
+
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    return (variant, ratio, _spmd.trsm_trace_key(), coll.collectives_trace_key())
+    return (variant, ratio, bool(get_tune_parameters().trsm_lookahead),
+            _spmd.trsm_trace_key(), coll.collectives_trace_key())
 
 
 def _dist_for(n_bucket: int, mb: int, grid: Grid, shard_batch: bool, k: int | None = None):

@@ -128,9 +128,14 @@ class CompiledCache:
                 self.counters["hit"] += 1
                 self._entries.move_to_end(key)
                 fn = self._entries[key]
-                om.emit("serve", event="cache_hit", bucket=bucket_label(key), **labels)
-                return fn
-            self.counters["miss"] += 1
+            else:
+                fn = None
+                self.counters["miss"] += 1
+        if fn is not None:
+            # emit outside the lock like the miss/compile/evict paths: the
+            # metrics sink may do I/O and hits are the hot path
+            om.emit("serve", event="cache_hit", bucket=bucket_label(key), **labels)
+            return fn
         om.emit("serve", event="cache_miss", bucket=bucket_label(key), **labels)
         t0 = time.perf_counter()
         with serving(key):

@@ -268,10 +268,14 @@ class Gateway:
                 reason=reason, where=where)
         if not req.future.done():
             if reason == "deadline":
+                # dlaf: ignore[DLAF004] eviction sheds never left the gateway:
+                # no pool callback is attached yet and _cond wraps an RLock,
+                # so client callbacks that re-enter the gateway are safe
                 req.future.set_exception(DeadlineExceededError(
                     0.0, label=f"gateway:{req.kind}:{where}"
                 ))
             else:
+                # dlaf: ignore[DLAF004] same as above — shed before dispatch
                 req.future.set_exception(QueueFullError(
                     self.max_queue, self.max_queue,
                     message=(
@@ -311,7 +315,14 @@ class Gateway:
                     if timeout == 0.0:
                         break
                     self._cond.wait(timeout)
-                self._pump_locked()
+                ready = self._pump_locked()
+            # dispatch OUTSIDE the lock: route() probes replicas and
+            # pool.adopt() takes the pool's own lock (and the pool's
+            # done-callbacks re-enter self._cond) — blocking here under
+            # the condition would stall submitters, stats() and the
+            # callbacks that drain _pending (the shipped livelock)
+            for key, fb, live in ready:
+                self._dispatch(key, fb, live)
 
     def _wait_timeout_locked(self, now: float):
         """Seconds until the dispatcher has work (0.0 = work is ready,
@@ -328,22 +339,20 @@ class Gateway:
             bounds.append(max(t, self._hold_until) - now)
         return max(min(bounds), 0.0)
 
-    def _pump_locked(self) -> None:
+    def _pump_locked(self) -> list:
+        """Form batches from the WFQ and return the ready ones as
+        ``(key, fb, live)`` tuples for the caller to dispatch OUTSIDE the
+        lock.  Nothing here routes or touches a pool; the hold cannot move
+        while the lock is held, so one check per phase suffices."""
+        ready: list = []
         now = time.monotonic()
         if now < self._hold_until:
-            return
+            return ready
         # pop in WFQ service order into per-group forming batches; a full
-        # batch flushes immediately, everything else waits out its linger
+        # batch is taken immediately, everything else waits out its linger
         while len(self._fq):
-            # a flush can saturate the backend (or find every mesh down)
-            # and requeue its overflow right back into _fq — re-check the
-            # hold against a FRESH clock every iteration and bail out, so
-            # the lock releases and the pool's done-callbacks (which block
-            # on it) can drain; popping again here would spin forever
-            now = time.monotonic()
-            if now < self._hold_until:
-                return
             req, cfg = self._fq.pop()
+            now = time.monotonic()
             if req.expiry is not None and req.expiry <= now:
                 self._evict_locked(req, cfg, reason="deadline", where="queued")
                 continue
@@ -356,17 +365,21 @@ class Gateway:
             fb["pairs"].append((req, cfg))
             self._forming_n += 1
             if len(fb["pairs"]) >= self.max_batch:
-                self._flush_locked(key, now)
+                taken = self._take_locked(key, now)
+                if taken is not None:
+                    ready.append(taken)
         now = time.monotonic()
-        if now < self._hold_until:
-            return
         for key in [k for k, fb in self._forming.items()
                     if fb["t_flush"] <= now or self._closed]:
-            # a flush in this very loop may set the hold too
-            if key in self._forming and now >= self._hold_until:
-                self._flush_locked(key, now)
+            taken = self._take_locked(key, now)
+            if taken is not None:
+                ready.append(taken)
+        return ready
 
-    def _flush_locked(self, key, now: float) -> None:
+    def _take_locked(self, key, now: float):
+        """Pop forming batch ``key``, shed members that expired while
+        lingering, and return ``(key, fb, live)`` — or None when nothing
+        is left alive."""
         fb = self._forming.pop(key)
         self._forming_n -= len(fb["pairs"])
         live = []
@@ -376,11 +389,39 @@ class Gateway:
                 self._evict_locked(req, cfg, reason="deadline", where="forming")
             else:
                 live.append((req, cfg))
-        if not live:
-            return
+        return (key, fb, live) if live else None
+
+    def _dispatch(self, key, fb, live) -> None:
+        """Route one taken batch and hand it to a replica pool.
+
+        Runs with self._cond NOT held.  Only the dispatcher thread forms
+        and takes batches, so an in-flight batch cannot race a concurrent
+        pump for the same key; admission-time eviction scans simply cannot
+        see it (bounded exposure: at most max_batch requests).  The lock
+        is re-acquired only for the state updates (requeue, hold, stats).
+        """
+        now = time.monotonic()
         rep = self.router.route()
         if rep is None:
-            if self._closed:
+            with self._cond:
+                closed = self._closed
+                if not closed:
+                    # every mesh is down: hold the batch, retry after backoff.
+                    # Merge if a batch re-formed for this key meanwhile — one
+                    # pump can take two batches of a key, and overwriting
+                    # would orphan the first batch's futures.
+                    backoff = max(self.linger_s, 0.05)
+                    prev = self._forming.get(key)
+                    if prev is not None:
+                        prev["pairs"].extend(live)
+                        prev["t_flush"] = max(prev["t_flush"], now + backoff)
+                    else:
+                        fb["pairs"] = live
+                        fb["t_flush"] = now + backoff
+                        self._forming[key] = fb
+                    self._forming_n += len(live)
+                    self._hold_until = max(self._hold_until, now + backoff)
+            if closed:
                 for req, cfg in live:
                     if not req.future.done():
                         req.future.set_exception(DeviceUnresponsiveError(
@@ -389,34 +430,31 @@ class Gateway:
                                 f"dispatch {req.kind} request"
                             ),
                         ))
-                return
-            # every mesh is down: hold the batch and retry after a backoff
-            backoff = max(self.linger_s, 0.05)
-            fb["pairs"] = live
-            fb["t_flush"] = now + backoff
-            self._forming[key] = fb
-            self._forming_n += len(live)
-            self._hold_until = max(self._hold_until, now + backoff)
-            om.emit("serve", event="gw_hold", reason="no_replica", batch=len(live))
+            else:
+                om.emit("serve", event="gw_hold", reason="no_replica",
+                        batch=len(live))
             return
         overflow = rep.pool.adopt([req for req, _ in live])
         adopted = len(live) - len(overflow)
+        fill = adopted / self.max_batch
+        with self._cond:
+            if adopted:
+                self._gw["batches"] += 1
+                self._gw["dispatched"] += adopted
+                self._gw["fill_sum"] += fill
+            if overflow:
+                # adopt keeps order, so the overflow is live's tail: requeue
+                # it and back off before pumping again rather than spinning
+                for req, cfg in live[adopted:]:
+                    self._fq.push((req, cfg), cfg)
+                self._hold_until = max(
+                    self._hold_until, now + max(self.linger_s, 0.005)
+                )
         if adopted:
-            fill = adopted / self.max_batch
-            self._gw["batches"] += 1
-            self._gw["dispatched"] += adopted
-            self._gw["fill_sum"] += fill
             om.emit("serve", event="gw_batch", replica=rep.name, op=key[0],
                     bucket=str(key[2]), batch=adopted, fill=fill,
                     linger_s=now - fb["t0"])
         if overflow:
-            # adopt keeps order, so the overflow is live's tail: requeue it
-            # and back off before pumping again rather than spinning hot
-            for req, cfg in live[adopted:]:
-                self._fq.push((req, cfg), cfg)
-            self._hold_until = max(
-                self._hold_until, now + max(self.linger_s, 0.005)
-            )
             om.emit("serve", event="gw_hold", reason="backend_full",
                     replica=rep.name, batch=len(overflow))
 

@@ -316,9 +316,19 @@ class SolverPool:
         # waiting for a sibling pool's dispatch is queue time, and the
         # queued-expiry check in _run re-screens on the next wakeup
         with _EXEC_LOCK:
-            self._dispatch_locked(key, reqs)
+            done = self._dispatch_locked(key, reqs)
+        # futures complete only after _EXEC_LOCK is released: done-callbacks
+        # run synchronously on the completing thread and must not serialize
+        # — or deadlock against — every other pool's device dispatch
+        for r, outcome in done:
+            if r.future.cancelled():
+                continue
+            if isinstance(outcome, BaseException):
+                r.future.set_exception(outcome)
+            else:
+                r.future.set_result(outcome)
 
-    def _dispatch_locked(self, key, reqs) -> None:
+    def _dispatch_locked(self, key, reqs) -> list:
         kind, uplo, bucket, _, _, _ = key
         t0 = time.monotonic()
         budgets = [r.remaining() for r in reqs if r.expiry is not None]
@@ -360,10 +370,7 @@ class SolverPool:
                     cache=self.cache, seconds=seconds, label=f"serve:{kind}",
                 )
         except BaseException as exc:  # noqa: BLE001 - routed to the futures
-            for r in reqs:
-                if not r.future.cancelled():
-                    r.future.set_exception(exc)
-            return
+            return [(r, exc) for r in reqs]
         # warm only on success: a cold dispatch that dies before (or
         # during) the first compile leaves the group cold, so later
         # requests still get the compile grace instead of being shed
@@ -371,6 +378,7 @@ class SolverPool:
         elapsed = time.monotonic() - t0
         om.emit("serve", event="batch", op=kind, bucket=str(bucket),
                 batch=len(reqs), seconds=elapsed)
+        done = []
         for i, r in enumerate(reqs):
             queue_s = t0 - r.t_submit
             if kind == "eigh":
@@ -385,5 +393,5 @@ class SolverPool:
                                   queue_s=queue_s, x=out.copy())
             om.emit("serve", event="request_done", op=kind, bucket=str(bucket),
                     queue_s=queue_s, info=int(info[i]))
-            if not r.future.cancelled():
-                r.future.set_result(res)
+            done.append((r, res))
+        return done

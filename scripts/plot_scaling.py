@@ -58,7 +58,7 @@ def main(path="scaling.csv", outdir=None):
         for n in sorted({r["n"] for r in rs}):
             pts = sorted((r["ranks"], r["gflops"]) for r in rs if r["n"] == n)
             if len(pts) > 1:
-                ax.plot(*zip(*pts), marker="o", label=f"N={n}")
+                ax.plot(*zip(*pts, strict=True), marker="o", label=f"N={n}")
         if ax.lines:
             ax.set_xlabel("devices")
             ax.set_ylabel("GFlop/s")
@@ -74,7 +74,7 @@ def main(path="scaling.csv", outdir=None):
         for grid in sorted({r["grid"] for r in rs}):
             pts = sorted((r["n"], r["gflops"]) for r in rs if r["grid"] == grid)
             if len(pts) > 1:
-                ax.plot(*zip(*pts), marker="o", label=grid)
+                ax.plot(*zip(*pts, strict=True), marker="o", label=grid)
         if ax.lines:
             ax.set_xlabel("N")
             ax.set_ylabel("GFlop/s")

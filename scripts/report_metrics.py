@@ -36,9 +36,55 @@ def _fmt_bytes(b: float) -> str:
     return f"{b:.1f}GiB"
 
 
+def _summarize_analysis(path: str, doc: dict) -> int:
+    """Roll-up for a ``python -m dlaf_tpu.analysis --format json`` findings
+    file (a single JSON object, not a metrics JSONL stream)."""
+    from dlaf_tpu.analysis.rules import RULES
+
+    counts = doc.get("counts_by_rule", {})
+    total = sum(counts.values())
+    print(f"== {path}: {doc['tool']} findings "
+          f"(schema {doc.get('schema', '?')}, {doc.get('files', '?')} files)")
+    print(f"-- findings: {total} total, {len(doc.get('new', []))} new, "
+          f"{len(doc.get('suppressed', []))} suppressed, "
+          f"{len(doc.get('stale_baseline', []))} stale baseline entries")
+    summaries = {r.RULE: r.SUMMARY for r in RULES}
+    for rule in sorted(set(counts) | set(doc.get("rules", []))):
+        print(f"   {rule}: {counts.get(rule, 0):4d}  "
+              f"{summaries.get(rule, '')}")
+    worst = doc.get("findings", [])[:10]
+    for f in worst:
+        print(f"   {f['rule']} {f['path']}:{f['line']} [{f['symbol']}] "
+              f"{f['message']}")
+    if len(doc.get("findings", [])) > 10:
+        print(f"   ... {len(doc['findings']) - 10} more (see the JSON)")
+    ok = doc.get("ok", total == 0)
+    print(f"-- analysis: {'clean' if ok else 'FINDINGS OUTSIDE BASELINE'}")
+    return 0 if ok else 1
+
+
+def _load_analysis_doc(path: str):
+    """The parsed findings object when ``path`` is a dlaf_tpu.analysis JSON
+    report, else None (JSONL metrics streams and anything else fall through
+    to the schema-validated reader)."""
+    import json
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if isinstance(doc, dict) and doc.get("tool") == "dlaf_tpu.analysis":
+        return doc
+    return None
+
+
 def summarize(path: str) -> int:
     from dlaf_tpu.obs import metrics
 
+    doc = _load_analysis_doc(path)
+    if doc is not None:
+        return _summarize_analysis(path, doc)
     recs = metrics.read_jsonl(path)
     print(f"== {path}: {len(recs)} records ({metrics.SCHEMA})")
     by_kind = defaultdict(list)
@@ -299,9 +345,10 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__.strip())
         return 0 if argv else 2
+    rc = 0
     for path in argv:
-        summarize(path)
-    return 0
+        rc = max(rc, summarize(path))
+    return rc
 
 
 if __name__ == "__main__":

@@ -88,6 +88,20 @@ def test_serving_token_scopes_trace_key():
     assert serve_trace_key() is None
 
 
+def test_serve_trace_knobs_carry_trsm_lookahead():
+    """DLAF001 regression: ``trsm_lookahead`` selects the posv matrix-mode
+    solve kernel inside the cached builder, so the serve executable key
+    must separate the two variants — with the knob outside the key, a
+    runtime flip silently replayed the stale executable."""
+    from dlaf_tpu.serve import batched
+
+    with _tuned(trsm_lookahead=True):
+        on = batched._trace_knobs("bucketed")
+    with _tuned(trsm_lookahead=False):
+        off = batched._trace_knobs("bucketed")
+    assert on != off
+
+
 # ----------------------------------------------------- batched bit-exactness
 
 
@@ -666,6 +680,39 @@ def test_pool_adopt_returns_overflow_untouched():
         finally:
             gate.set()
             pool.close()
+
+
+def test_pool_future_callbacks_run_outside_exec_lock():
+    """DLAF004 regression: ``_dispatch`` used to hold the module
+    ``_EXEC_LOCK`` (a plain, non-reentrant Lock) while completing futures.
+    Done-callbacks run synchronously on the dispatcher thread, so any
+    callback touching the serve layer — a resubmit, anything that
+    dispatches behind the same lock — deadlocked.  Futures must complete
+    only after the lock drops."""
+    from dlaf_tpu.serve import pool as pool_mod
+
+    a = tu.random_hermitian_pd(16, np.float32, seed=620)
+    with _tuned(serve_buckets="16"):
+        pool, gate = _gated_pool(block_size=8, cache=serve.CompiledCache())
+        with pool:
+            fut = pool.submit("potrf", "L", a)
+            acquired = []
+            fired = threading.Event()
+
+            def grab_exec_lock(_f):
+                ok = pool_mod._EXEC_LOCK.acquire(timeout=5.0)
+                if ok:
+                    pool_mod._EXEC_LOCK.release()
+                acquired.append(ok)
+                fired.set()
+
+            # the worker is parked at the gate, so the callback is attached
+            # before the dispatch can possibly complete
+            fut.add_done_callback(grab_exec_lock)
+            gate.set()
+            assert pool.result(fut, timeout=300).info == 0
+            assert fired.wait(30.0)
+            assert acquired == [True]
 
 
 # --------------------------------------------------------- cache event labels

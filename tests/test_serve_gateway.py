@@ -545,6 +545,54 @@ def test_gateway_backend_saturation_holds_instead_of_livelock():
             pool.close()
 
 
+def test_gateway_dispatch_routes_and_adopts_outside_lock():
+    """DLAF004 regression: ``router.route()`` + ``pool.adopt()`` run with
+    the gateway condition RELEASED.  A backend whose adopt blocks (pool
+    lock contention, a compile in a sibling thread) must not freeze
+    admission, stats() or the pool done-callbacks — the old dispatcher
+    flushed under ``self._cond`` and stalled all three."""
+
+    class _BlockingAdoptPool:
+        def __init__(self):
+            self.entered = threading.Event()
+            self.release = threading.Event()
+
+        def pending(self):
+            return 0
+
+        def adopt(self, reqs):
+            self.entered.set()
+            assert self.release.wait(60.0)
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_result("stub")
+            return []
+
+    pool = _BlockingAdoptPool()
+    a = _spd(16, seed=33)
+    with _tuned(serve_buckets="16"):
+        gw = serve.Gateway(pool, [TenantConfig("t")], max_batch=1,
+                           linger_ms=0.0)
+        try:
+            f1 = gw.submit_nowait("t", "potrf", "L", a)
+            assert pool.entered.wait(30.0)  # dispatcher is inside adopt
+            # while adopt blocks, the gateway lock must be free: stats()
+            # and a fresh admission both need it
+            got = {}
+            t = threading.Thread(target=lambda: got.update(gw.stats()))
+            t.start()
+            t.join(10.0)
+            assert not t.is_alive()
+            assert got["tenants"]["t"]["admitted"] == 1
+            f2 = gw.submit_nowait("t", "potrf", "L", a)
+            pool.release.set()
+            assert f1.result(timeout=60) == "stub"
+            assert f2.result(timeout=60) == "stub"
+        finally:
+            pool.release.set()
+            gw.close()
+
+
 def test_gateway_queue_full_shed_does_not_burn_quota():
     """REVIEW regression: a request shed with gateway-queue-full must not
     consume the tenant's token bucket (pending/queue checks run before
