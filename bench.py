@@ -336,6 +336,17 @@ class _Child:
                 self._note(f"serve batched posv failed: {type(e).__name__}: {e}")
         else:
             self._note(f"serve batched posv skipped: {self.t_left():.0f}s left")
+        # split-GEMM tier A/B (f32 — must run before the x64 flip below):
+        # bf16x3-tier posv with refine_to='input' vs the default tier,
+        # residual printed beside every GFlop/s column
+        if self.t_left() > 150:
+            try:
+                self.rec["posv_precision"] = self._time_posv_bf16x3_refined(2048)
+                self._flush()
+            except BaseException as e:  # noqa: BLE001
+                self._note(f"posv bf16x3 failed: {type(e).__name__}: {e}")
+        else:
+            self._note(f"posv bf16x3 skipped: {self.t_left():.0f}s left")
         # LAST (flips x64; nothing f32 runs after): the mixed-precision A/B —
         # f32-factor-plus-refinement posv vs emulated-f64 posv, the
         # on-hardware number behind the round-4 mixed-precision claim
@@ -417,6 +428,67 @@ class _Child:
             loop_s = time.perf_counter() - t0
             rec["single_loop_seconds"] = round(loop_s, 4)
             rec["speedup_vs_single_loop"] = round(loop_s / best, 2)
+        return rec
+
+    def _time_posv_bf16x3_refined(self, n):
+        """Split-GEMM tier A/B at N=``n``, nrhs=16, f32: default-tier posv
+        vs bf16x3-tier posv with ``refine_to='input'`` (residual-corrected
+        back to input rounding).  Each column carries its measured
+        normalized residual so the throughput is never read without the
+        accuracy it was bought at."""
+        import dlaf_tpu.testing as tu
+        from dlaf_tpu import tune
+        from dlaf_tpu.algorithms.solver import positive_definite_solver
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+        from dlaf_tpu.miniapp.common import sync
+
+        # full mesh, NOT 1x1: the single-device posv fast path factors via
+        # jnp.linalg.cholesky and never traces a contract — only the SPMD
+        # trailing updates feel the tier
+        grid = Grid.create()
+        a = tu.random_hermitian_pd(n, np.float32, seed=3)
+        b = tu.random_matrix(n, 16, np.float32, seed=4)
+        anorm = float(np.max(np.abs(a)))
+        flops = n**3 / 3 + 4 * n**2 * 16
+        rec = {"metric": f"posv_bf16x3_refined_n{n}_f32", "n": n, "nrhs": 16}
+        tp = tune.get_tune_parameters()
+        saved = tp.gemm_precision
+        try:
+            for col, tier, refine in (
+                ("default", "default", None),
+                ("bf16x3_refined", "bf16x3", "input"),
+            ):
+                best = x = None
+                for _ in range(2):  # warmup/compile, then timed
+                    tp.update(gemm_precision=tier)
+                    mat_a = DistributedMatrix.from_global(grid, np.tril(a), (NB, NB))
+                    mat_b = DistributedMatrix.from_global(grid, b, (NB, NB))
+                    sync(mat_a.data)
+                    t0 = time.perf_counter()
+                    x = positive_definite_solver("L", mat_a, mat_b, refine_to=refine)
+                    sync(x.data)
+                    best = time.perf_counter() - t0
+                xh = np.asarray(x.to_global())
+                resid = float(
+                    np.max(np.abs(b - a @ xh))
+                    / (anorm * max(float(np.max(np.abs(xh))), 1e-30))
+                )
+                rec[col] = {
+                    "seconds": round(best, 3),
+                    "gflops": round(flops / best / 1e9, 3),
+                    "residual": resid,
+                    "gemm_precision": tier,
+                    "refine_to": refine,
+                }
+                if self.t_left() < 45:
+                    break
+        finally:
+            tp.update(gemm_precision=saved)
+        if "default" in rec and "bf16x3_refined" in rec:
+            rec["speedup"] = round(
+                rec["default"]["seconds"] / rec["bf16x3_refined"]["seconds"], 2
+            )
         return rec
 
     def _time_posv_mixed(self, n):

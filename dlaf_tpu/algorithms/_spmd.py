@@ -74,6 +74,22 @@ def trsm_trace_key() -> bool:
     return bool(get_tune_parameters().panel_trsm_pallas)
 
 
+def gemm_precision_trace_key() -> str:
+    """``tune.gemm_precision`` is consulted at TRACE time inside
+    ``ops.tile.contract`` (the split-GEMM tier of every trailing-update
+    contraction), so every compiled kernel that traces a contract must
+    carry it in its compile-cache key — a knob outside the key is a dead
+    knob (same discipline as :func:`trsm_trace_key`).  Folds in the
+    ambient ``tune.gemm_precision_scope`` override (refinement residual
+    GEMMs run under scope('default')), so scoped and unscoped traces never
+    alias one executable.  'auto' is keyed as-is: its per-site resolution
+    depends only on static shapes (already key state via Geometry) and the
+    backend (fixed per process)."""
+    from dlaf_tpu.tune import resolved_gemm_precision
+
+    return resolved_gemm_precision()
+
+
 def serve_trace_key():
     """The active serve-bucket token (None outside ``dlaf_tpu.serve``) —
     same discipline as :func:`trsm_trace_key`: compilations triggered on

@@ -27,6 +27,7 @@ from dlaf_tpu.algorithms.reduction_to_band import _t_factor
 from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
 
 
 def _panel_v_tmat(a, taus, p, g_a: _spmd.Geometry, band: int):
@@ -73,9 +74,9 @@ def _bt_r2b_kernel(
         # E -= V T (V^H E): rows block-cyclic over 'r', W psum'd across it
         v_tiles = v.reshape(np_ // g_a.mb, g_a.mb, band)
         vr = jnp.take(v_tiles, gi, axis=0)  # [ltr, mb, band]
-        w = coll.psum_axis(jnp.einsum("iab,ijac->jbc", vr.conj(), e), ROW_AXIS)
-        tw = jnp.einsum("ab,jbc->jac", tmat, w)
-        return e - jnp.einsum("iab,jbc->ijac", vr, tw)
+        w = coll.psum_axis(t.contract("iab,ijac->jbc", vr.conj(), e), ROW_AXIS)
+        tw = t.contract("ab,jbc->jac", tmat, w)
+        return e - t.contract("iab,jbc->ijac", vr, tw)
 
     e = lax.fori_loop(0, n_panels, body, e)
     return coll.relocal(e)
@@ -92,8 +93,8 @@ def _bt_r2b_cols_kernel(a, taus, e, g_a: _spmd.Geometry, n_panels: int, band: in
     def body(s, e):
         p = n_panels - 1 - s
         v, tmat = _panel_v_tmat(a, taus, p, g_a, band)
-        w = v.conj().T @ e  # [band, kloc] — no psum: full rows are local
-        return e - v @ (tmat @ w)
+        w = t.contract("ka,kb->ab", v.conj(), e)  # [band, kloc] — no psum: full rows are local
+        return e - t.contract("ab,bc->ac", v, tmat @ w)
 
     return lax.fori_loop(0, n_panels, body, e)
 
@@ -129,7 +130,7 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
     key = (
         "cols", grid.cache_key, g_a, dist, tuple(cols.data.shape),
         n_panels, band, prec, np.dtype(cols.data.dtype),
-        coll.collectives_trace_key(),
+        coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(),
     )
     if key not in _cache:
 
@@ -204,7 +205,7 @@ def bt_reduction_to_band(
 
     prec = get_tune_parameters().eigensolver_matmul_precision
     key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec,
-           coll.collectives_trace_key())
+           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
     if key not in _cache:
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))

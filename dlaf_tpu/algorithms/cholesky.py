@@ -194,7 +194,7 @@ def _chol_step(k, x, info, g: _spmd.Geometry, myr, myc, gi, want_info: bool):
     x = _spmd.put_col(x, new_col, lkc)
     # 4. trailing update: A[i,j] -= L[i,k] L[j,k]^H  (one batched matmul)
     with _scope("chol.trailing_update"):
-        x = x - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+        x = x - t.contract("iab,jcb->ijac", cp, rp.conj())
     return x, info
 
 
@@ -294,7 +294,7 @@ def _chol_L_bucketed_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         # trailing update on the window
         with _scope("chol.trailing_update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-            xs = xs - jnp.einsum("iab,jcb->ijac", cp, rp.conj())
+            xs = xs - t.contract("iab,jcb->ijac", cp, rp.conj())
             out = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
             return (out, info) if want_info else out
 
@@ -380,7 +380,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         l_next = (k + 1) // g.pc
         xc1 = _spmd.take_col(x, l_next, g)
         rp1 = _spmd.take_tile(rp, l_next)
-        upd1 = jnp.einsum("iab,cb->iac", cp, rp1.conj())
+        upd1 = t.contract("iab,cb->iac", cp, rp1.conj())
         xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
         x = _spmd.put_col(x, xc1, l_next)
         # lookahead: panel k+1 from the already-updated column
@@ -390,7 +390,7 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         # bulk trailing update, column k+1 excluded (already updated)
         with _scope("chol.trailing_update"):
             rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
-            x = x - jnp.einsum("iab,jcb->ijac", cp, rp_bulk.conj())
+            x = x - t.contract("iab,jcb->ijac", cp, rp_bulk.conj())
         return (x, lkk1, cp1, info) if want_info else (x, lkk1, cp1)
 
     lkk0, cp0, bad0 = compute_panel(x, 0)
@@ -414,7 +414,8 @@ def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
     # only the bucketed variant bakes ratio-dependent segments
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
     key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(),
-           coll.collectives_trace_key(), _spmd.serve_trace_key(), want_info)
+           coll.collectives_trace_key(), _spmd.serve_trace_key(),
+           _spmd.gemm_precision_trace_key(), want_info)
     if key not in _kernel_cache:
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,
@@ -448,7 +449,7 @@ def _compiled_range(grid, g: _spmd.Geometry):
     Built directly on ``shard_map_compat`` (not :func:`coll.spmd`, whose
     uniform ``P('r','c')`` in_specs would shard the scalar bounds)."""
     key = (grid.cache_key, g, _spmd.trsm_trace_key(), coll.collectives_trace_key(),
-           _spmd.serve_trace_key())
+           _spmd.serve_trace_key(), _spmd.gemm_precision_trace_key())
     if key not in _range_cache:
         P = jax.sharding.PartitionSpec
         spec = P(ROW_AXIS, COL_AXIS)
@@ -516,7 +517,7 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
 
     dist = mat_a.dist
     key = (dist, np.dtype(mat_a.dtype), uplo, _spmd.trsm_trace_key(),
-           _spmd.serve_trace_key())
+           _spmd.serve_trace_key(), _spmd.gemm_precision_trace_key())
     if key not in _local_cache:
 
         @jax.jit

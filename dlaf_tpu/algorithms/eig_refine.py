@@ -47,6 +47,7 @@ from dlaf_tpu.algorithms.multiplication import (
     general_multiplication,
     hermitian_multiplication,
 )
+from dlaf_tpu.algorithms.refine import convergence_floor, max_abs as _max_abs
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.matrix.util import _global_element_grids
 from dlaf_tpu.ops import tile as t
@@ -249,7 +250,7 @@ def refine_eigenpairs(
             lam_host = np.asarray(lam)[:n]
             # attainable floor: the Gram matrix itself carries ~n*eps GEMM
             # rounding, so demanding sqrt(n)*eps would never converge
-            if info.ortho_error <= n * eps * 50:
+            if info.ortho_error <= convergence_floor(n, target):
                 info.converged = True
                 break
             if it == max_iters or not np.isfinite(info.ortho_error):
@@ -303,15 +304,6 @@ def _col_scale_sub(ax_data, x_data, theta_pad, dist):
     inb = (gi < m) & (gj < k)
     th = theta_pad[jnp.clip(gj, 0, theta_pad.shape[0] - 1)].astype(x_data.dtype)
     return jnp.where(inb, ax_data - x_data * th, 0)
-
-
-@partial(jax.jit, static_argnums=(1,))
-def _max_abs(data, dist):
-    gi, gj = _global_element_grids(dist)
-    m, k = dist.size
-    r = jnp.where((gi < m) & (gj < k), jnp.abs(data), 0)
-    bad = jnp.any(jnp.isnan(r))
-    return jnp.where(bad, jnp.asarray(jnp.nan, r.dtype), jnp.max(r))
 
 
 @partial(jax.jit, static_argnums=(4,))
@@ -488,7 +480,7 @@ def refine_partial_eigenpairs(
             res = float(_max_abs(r.data, r.dist)) / scale
             info.iters = it
             info.residual = res  # ortho_error stays inf: cholqr re-orthonormalizes
-            if res <= n * eps * 50:
+            if res <= convergence_floor(n, target):
                 info.converged = True
                 break
             if it == max_iters or not np.isfinite(res):

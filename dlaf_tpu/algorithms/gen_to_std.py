@@ -88,7 +88,7 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
             xa = lax.dynamic_slice(a, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
             xl = lax.dynamic_slice(b, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
             pan = t.trsm(t.RIGHT, t.LOWER, t.CONJ_TRANS, t.NON_UNIT, 1.0, lkk, xa)
-            corr = jnp.asarray(half, a.dtype) * jnp.einsum("iab,bc->iac", xl, akk)
+            corr = jnp.asarray(half, a.dtype) * t.contract("iab,bc->iac", xl, akk)
             pan1 = pan - corr  # the value her2k uses
             mine_c = myc == kc
             cp_a = coll.bcast(
@@ -107,8 +107,8 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
         # her2k on the trailing window: A -= L_p P^H + P L_p^H
         with _scope("hegst.her2k"):
             xs = lax.dynamic_slice(a, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-            xs = xs - jnp.einsum("iab,jcb->ijac", cp_l, rp_a.conj())
-            xs = xs - jnp.einsum("iab,jcb->ijac", cp_a, rp_l.conj())
+            xs = xs - t.contract("iab,jcb->ijac", cp_l, rp_a.conj())
+            xs = xs - t.contract("iab,jcb->ijac", cp_a, rp_l.conj())
             return lax.dynamic_update_slice(a, xs, (rs, cs, 0, 0))
 
     for k0, k1 in _spmd.halving_segments(g.mt):
@@ -151,8 +151,14 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
         return mat_a_full
     if (g.mb, g.pr, g.pc, g.mt) != (g_b.mb, g_b.pr, g_b.pc, g_b.mt):
         raise ValueError("gen_to_std: A and B distributions must match")
+    from dlaf_tpu.tune import get_tune_parameters
+
+    # trsm_lookahead is only traced by the phase-B triangular_solver call
+    # (own kernel cache); carrying it here over-keys phase A harmlessly
+    # (same idiom as serve._trace_knobs) and keeps DLAF001 exact
+    lookahead = bool(get_tune_parameters().trsm_lookahead)
     key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio(), _spmd.trsm_trace_key(),
-           coll.collectives_trace_key())
+           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(), lookahead)
     if key not in _cache:
         _cache[key] = coll.spmd(
             mat_a_full.grid,

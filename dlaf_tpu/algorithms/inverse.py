@@ -61,9 +61,9 @@ def _trtri_lower_kernel(x, g: _spmd.Geometry, diag):
         # S[i] = sum_j inv[i,j] L[j,k] over trailing cols (inv cols > k final);
         # tiles above the diagonal are never referenced (may hold garbage)
         keep_cols = ((gj > k)[None, :] & (gi[:, None] >= gj[None, :]))[:, :, None, None]
-        s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep_cols, x, jnp.zeros_like(x)), rp)
+        s_part = t.contract("ijab,jbc->iac", jnp.where(keep_cols, x, jnp.zeros_like(x)), rp)
         s_full = coll.psum_axis(s_part, COL_AXIS)
-        newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
+        newcol = -t.contract("iab,bc->iac", s_full, tkk)
         newcol = jnp.where(
             (gi == k)[:, None, None], tkk[None], jnp.where(below, newcol, xc)
         )
@@ -108,9 +108,9 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
         with _scope("trtri.update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
             keep = ((gj_w > k)[None, :] & (gi_w[:, None] >= gj_w[None, :]))[:, :, None, None]
-            s_part = jnp.einsum("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
+            s_part = t.contract("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
             s_full = coll.psum_axis(s_part, COL_AXIS)
-            newcol = -jnp.einsum("iab,bc->iac", s_full, tkk)
+            newcol = -t.contract("iab,bc->iac", s_full, tkk)
         newcol = jnp.where(below & (myc == kc), newcol, xc)
         x = lax.dynamic_update_slice(x, newcol[:, None], (rs, lkc, 0, 0))
         # diagonal tile write (outside the window)
@@ -159,9 +159,9 @@ def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
         with _scope("trtri.update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
             keep = ((gi_w > k)[:, None] & (gi_w[:, None] <= gj_w[None, :]))[:, :, None, None]
-            s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
+            s_part = t.contract("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
             s_full = coll.psum_axis(s_part, ROW_AXIS)
-            newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
+            newrow = -t.contract("ab,jbc->jac", tkk, s_full)
         newrow = jnp.where(right & (myr == kr), newrow, xr)
         x = lax.dynamic_update_slice(x, newrow[None, :], (lkr, cs, 0, 0))
         mine_d = (myr == kr) & (myc == kc)
@@ -200,9 +200,9 @@ def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
         # S[j] = sum_i U[k,i] inv[i,j] over trailing rows (inv rows > k final);
         # tiles below the diagonal are never referenced (may hold garbage)
         keep_rows = ((gi > k)[:, None] & (gi[:, None] <= gj[None, :]))[:, :, None, None]
-        s_part = jnp.einsum("iab,ijbc->jac", cp, jnp.where(keep_rows, x, jnp.zeros_like(x)))
+        s_part = t.contract("iab,ijbc->jac", cp, jnp.where(keep_rows, x, jnp.zeros_like(x)))
         s_full = coll.psum_axis(s_part, ROW_AXIS)
-        newrow = -jnp.einsum("ab,jbc->jac", tkk, s_full)
+        newrow = -t.contract("ab,jbc->jac", tkk, s_full)
         newrow = jnp.where(
             (gj == k)[:, None, None], tkk[None], jnp.where(right, newrow, xr)
         )
@@ -226,7 +226,8 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, str(mat_a.dtype), uplo, diag, _spmd.trsm_trace_key())
+    key = (dist, str(mat_a.dtype), uplo, diag, _spmd.trsm_trace_key(),
+           _spmd.gemm_precision_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -262,7 +263,7 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
     # bucketed kernels bake ratio-dependent trailing windows at trace time
     ratio = _spmd.bucket_ratio()
     key = (mat_a.grid.cache_key, uplo, diag, g, ratio, _spmd.trsm_trace_key(),
-           coll.collectives_trace_key())
+           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
     if key not in _cache:
         kern_fn = (
             _trtri_lower_bucketed_kernel if uplo == t.LOWER else _trtri_upper_bucketed_kernel

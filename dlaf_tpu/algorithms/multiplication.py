@@ -152,7 +152,7 @@ def _summa_kernel(
             cp = _a_col_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltr, g_c.mt)
             rp = _b_row_panel(b, k, g_b, myr, myc, opb, g_c.ltc, g_c.nt)
         with _scope("summa.update"):
-            return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+            return c + al * t.contract("iab,jbc->ijac", cp, rp)
 
     c = lax.fori_loop(0, kt, body, c)
     return coll.relocal(c)
@@ -188,6 +188,7 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
     key = (
         "local", da, db, dc, np.dtype(mat_c.dtype), opa, opb,
         complex(alpha), complex(beta), structure, diag, a_right,
+        _spmd.gemm_precision_trace_key(),
     )
     if key not in _local_cache:
         from dlaf_tpu.matrix import layout
@@ -199,7 +200,11 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
             gc = layout.unpad_global(layout.unpack(xc, dc), dc)
             ga = t.op_tile(_dense_structured_a(ga, structure, diag), opa)
             gb = t.op_tile(gb, opb)
-            prod = (gb @ ga) if a_right else (ga @ gb)
+            prod = (
+                t.contract("...ab,...bc->...ac", gb, ga)
+                if a_right
+                else t.contract("...ab,...bc->...ac", ga, gb)
+            )
             out = jnp.asarray(alpha, gc.dtype) * prod + jnp.asarray(beta, gc.dtype) * gc
             return layout.pack(layout.pad_global(out.astype(gc.dtype), dc), dc)
 
@@ -221,6 +226,7 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
     key = (
         mat_c.grid.cache_key, opa, opb, complex(alpha), complex(beta), structure,
         diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
+        _spmd.gemm_precision_trace_key(),
     )
     if key not in _cache:
         kern = partial(
@@ -298,7 +304,7 @@ def _summa_right_kernel(a, b, c, g_a, g_b, g_c, opa, alpha, beta, structure, dia
             # transposed problem: op(A)[k, j] = opT(op(A)^T[j, k])
             rp = _a_row_panel(a, k, g_a, myr, myc, opa, structure, diag, g_c.ltc, g_c.nt)
         with _scope("summa.update"):
-            return c + al * jnp.einsum("iab,jbc->ijac", cp, rp)
+            return c + al * t.contract("iab,jbc->ijac", cp, rp)
 
     c = lax.fori_loop(0, kt, body, c)
     return coll.relocal(c)
@@ -358,6 +364,7 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
     key = (
         "right", mat_c.grid.cache_key, opa, complex(alpha), complex(beta),
         structure, diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
+        _spmd.gemm_precision_trace_key(),
     )
     if key not in _cache:
         kern = partial(
@@ -450,7 +457,7 @@ def _sub_gemm_kernel(
             bp = jnp.take(flat, jnp.clip(q_idx * Lg + s_idx, 0, pc * Lg - 1), axis=0)
         bp = jnp.where(valid_j[:, None, None], bp, jnp.zeros_like(bp))
         with _scope("summa.update"):
-            return acc + jnp.einsum("iab,jbc->ijac", ap, bp)
+            return acc + t.contract("iab,jbc->ijac", ap, bp)
 
     acc = lax.fori_loop(
         0, Rk, body, jnp.zeros((L, Cw, g_c.mb, g_c.nb), c.dtype)
@@ -527,7 +534,7 @@ def general_sub_multiplication(
     key = (
         "subgemm", mat_c.grid.cache_key, complex(alpha), complex(beta),
         origins, Ri, Rj, Rk, g_a, g_b, g_c, aliased,
-        coll.collectives_trace_key(),
+        coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(),
     )
     if key not in _cache:
         kern = partial(
@@ -553,7 +560,8 @@ def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
     oa, ob, oc = tuple(a_ref.origin), tuple(b_ref.origin), tuple(c_ref.origin)
     sa, sb, sc = tuple(a_ref.size), tuple(b_ref.size), tuple(c_ref.size)
     key = ("sublocal", da, db, dc, oa, ob, oc, sa, sb, sc,
-           np.dtype(c_ref.dtype), complex(alpha), complex(beta))
+           np.dtype(c_ref.dtype), complex(alpha), complex(beta),
+           _spmd.gemm_precision_trace_key())
     if key not in _local_cache:
         from dlaf_tpu.matrix import layout
 
@@ -565,7 +573,9 @@ def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
             aw = ga[oa[0] : oa[0] + sa[0], oa[1] : oa[1] + sa[1]]
             bw = gb[ob[0] : ob[0] + sb[0], ob[1] : ob[1] + sb[1]]
             cw = gc[oc[0] : oc[0] + sc[0], oc[1] : oc[1] + sc[1]]
-            new = jnp.asarray(alpha, gc.dtype) * (aw @ bw) + jnp.asarray(beta, gc.dtype) * cw
+            new = jnp.asarray(alpha, gc.dtype) * t.contract(
+                "...ab,...bc->...ac", aw, bw
+            ) + jnp.asarray(beta, gc.dtype) * cw
             gc = lax.dynamic_update_slice(gc, new.astype(gc.dtype), oc)
             return layout.pack(layout.pad_global(gc, dc), dc)
 

@@ -49,6 +49,7 @@ from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
+from dlaf_tpu.ops import tile as t
 
 
 def _panel_block_size(nb: int) -> int:
@@ -194,20 +195,20 @@ def _red2band_step(p, carry, g: _spmd.Geometry, band: int, myr, myc, L: int, C: 
     )  # [C, mb, band]
     with _scope("red2band.trailing_update"):
         xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-        xpart = jnp.einsum("ijab,jbc->iac", xs, vc)
+        xpart = t.contract("ijab,jbc->iac", xs, vc)
         xfull = coll.psum_axis(xpart, COL_AXIS)  # (A V) window rows
-        xt = jnp.einsum("iab,bc->iac", xfull, tmat)  # X = A V T
-        mpart = jnp.einsum("iab,iac->bc", vr.conj(), xt)
+        xt = t.contract("iab,bc->iac", xfull, tmat)  # X = A V T
+        mpart = t.contract("iab,iac->bc", vr.conj(), xt)
         mmat = coll.psum_axis(mpart, ROW_AXIS)  # M = V^H X
-        w2 = xt - 0.5 * jnp.einsum("iab,bc->iac", vr, tmat.conj().T @ mmat)
+        w2 = xt - 0.5 * t.contract("iab,bc->iac", vr, tmat.conj().T @ mmat)
         # mask W2 to the trailing region (element rows >= start)
         ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
         w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
         w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
         xs = (
             xs
-            - jnp.einsum("iab,jcb->ijac", w2, vc.conj())
-            - jnp.einsum("iab,jcb->ijac", vr, w2c.conj())
+            - t.contract("iab,jcb->ijac", w2, vc.conj())
+            - t.contract("iab,jcb->ijac", vr, w2c.conj())
         )
         x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
     # 4. write the factored panel strip back (element rows >= start on
@@ -272,7 +273,8 @@ def _compiled_range(grid, g: _spmd.Geometry, band: int, prec: str):
     a replicated taus carry.  Built on ``shard_map_compat`` directly — the
     scalar bounds and the replicated taus stack need ``P()`` in_specs that
     :func:`coll.spmd`'s uniform stacked specs cannot express."""
-    key = (grid.cache_key, g, band, prec, coll.collectives_trace_key())
+    key = (grid.cache_key, g, band, prec, coll.collectives_trace_key(),
+           _spmd.gemm_precision_trace_key())
     if key not in _range_cache:
         P = jax.sharding.PartitionSpec
         spec = P(ROW_AXIS, COL_AXIS)
@@ -402,7 +404,7 @@ def reduction_to_band(
         out.band_size = band
         return out, taus
     key = (mat_a.grid.cache_key, g, band, prec, _spmd.bucket_ratio(),
-           coll.collectives_trace_key())
+           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
     if key not in _cache:
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
         _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))

@@ -1,0 +1,134 @@
+"""Generic driver-level residual-correction refinement.
+
+One loop, shared by the dense solvers (``positive_definite_solver`` /
+``triangular_solver`` ``refine_to=``) and by the mixed-precision machinery
+it was factored out of (``positive_definite_solver_mixed``,
+``eig_refine``): solve cheaply — low precision, or the bf16 split-GEMM
+tiers (``tune.gemm_precision``) — then restore target accuracy with one
+or two GEMM-rich correction sweeps:
+
+    r = residual(x)          # FULL precision (gemm_precision_scope off)
+    d = correct(r)           # re-uses the cheap factorization / solver
+    x = x + d
+
+The residual evaluation is the only step that must be exact — it runs
+under ``gemm_precision_scope("default")`` so the split tiers never
+degrade it — while the corrections inherit the ambient (fast) tier:
+classical iterative refinement (LAPACK dsposv, SC'06 Langou et al.;
+Ogita-Aishima for the eigenproblem) where errors of the cheap solve are
+annihilated at first order per sweep.
+
+Convergence uses the dsposv criterion ``||r||_max <= ||x||_max * tol``
+with ``tol = ||A||_max * sqrt(N) * eps(target)`` — a normwise backward
+error at the rounding level of the target dtype.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.matrix.util import _global_element_grids
+
+#: accepted values of the solver drivers' ``refine_to=`` parameter.  None
+#: disables refinement (bit-identical legacy path); 'input' refines the
+#: solution to the input dtype's rounding level (the only target that makes
+#: sense for a solver whose operands ARE the input — eig_refine's richer
+#: targets stay local to it).
+REFINE_TARGETS = (None, "input")
+
+
+def validate_refine_to(value):
+    """Fail fast on a bad ``refine_to=`` (same shape as
+    ``tune.validate_gemm_precision``)."""
+    if value not in REFINE_TARGETS:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            f"refine_to must be one of {REFINE_TARGETS}, got {value!r}"
+        )
+    return value
+
+
+@dataclass
+class RefineInfo:
+    sweeps: int  # correction sweeps applied (0 = initial solve was enough)
+    converged: bool  # met ||r||_max <= ||x||_max * tol
+    residual: float  # final ||r||_max
+    backward_error: float  # final ||r||_max / (||x||_max * ||A||_max)
+
+
+def refine_tolerance(anorm: float, n: int, dtype) -> float:
+    """dsposv convergence tolerance ``||A||_max * sqrt(N) * eps(target)``
+    (real-part eps for complex dtypes)."""
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    return float(anorm) * float(np.sqrt(max(n, 1))) * float(eps)
+
+
+def convergence_floor(n: int, dtype, factor: float = 50.0) -> float:
+    """Attainable metric floor ``n * eps * factor``: a full-precision GEMM
+    itself carries ~n*eps rounding, so driving a residual-derived metric
+    below a small multiple of it only chases noise (shared with
+    ``eig_refine``'s ortho/residual stops)."""
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    return float(n) * float(eps) * float(factor)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def max_abs(data, dist):
+    """NaN-propagating max-abs over the in-bounds region of a stacked
+    layout (padding excluded; jnp.max alone would let padding zeros mask
+    an all-NaN iterate)."""
+    gi, gj = _global_element_grids(dist)
+    m, k = dist.size
+    r = jnp.where((gi < m) & (gj < k), jnp.abs(data), 0)
+    bad = jnp.any(jnp.isnan(r))
+    return jnp.where(bad, jnp.asarray(jnp.nan, r.dtype), jnp.max(r))
+
+
+def residual_refine(
+    x: DistributedMatrix,
+    residual_fn: Callable[[DistributedMatrix], DistributedMatrix],
+    correct_fn: Callable[[DistributedMatrix], DistributedMatrix],
+    *,
+    tol: float,
+    anorm: float = 1.0,
+    max_sweeps: int = 2,
+) -> tuple[DistributedMatrix, RefineInfo]:
+    """Refine ``x`` with up to ``max_sweeps`` residual-correction sweeps.
+
+    ``residual_fn(x)`` must return the TRUE residual of the underlying
+    system (e.g. ``B - A x``) as a new matrix; it is invoked under
+    ``gemm_precision_scope("default")`` so split-GEMM tiers never apply to
+    the residual.  ``correct_fn(r)`` solves the same system for the
+    correction (it may donate ``r``) and runs at the ambient tier — the
+    whole point is re-using the fast solve.  The loop exits early on
+    convergence and bails (no further corrections) on a NaN/inf iterate:
+    a correction cannot recover a poisoned solve.
+    """
+    from dlaf_tpu.tune import gemm_precision_scope
+
+    info = RefineInfo(0, False, np.inf, np.inf)
+    for sweep in range(max_sweeps + 1):
+        with gemm_precision_scope("default"):
+            r = residual_fn(x)
+        rnorm = float(max_abs(r.data, r.dist))
+        xnorm = float(max_abs(x.data, x.dist))
+        info.sweeps = sweep
+        info.residual = rnorm
+        info.backward_error = (
+            rnorm / (xnorm * float(anorm)) if xnorm and anorm else 0.0
+        )
+        if rnorm <= xnorm * tol:
+            info.converged = True
+            return x, info
+        if sweep == max_sweeps or not (np.isfinite(rnorm) and np.isfinite(xnorm)):
+            return x, info
+        d = correct_fn(r)
+        x = x.like(x.data + d.data.astype(x.dtype))
+    return x, info

@@ -94,6 +94,8 @@ def positive_definite_solver(
     mat_b: DistributedMatrix,
     return_info: bool = False,
     raise_on_failure: bool = False,
+    refine_to: str | None = None,
+    refine_sweeps: int = 2,
 ) -> DistributedMatrix:
     """POSV: factor the Hermitian positive-definite ``mat_a`` (in place —
     its ``uplo`` triangle holds the Cholesky factor on return) and solve
@@ -104,16 +106,60 @@ def positive_definite_solver(
     a lazy device scalar — see ``cholesky_factorization``);
     ``raise_on_failure=True`` raises
     :class:`~dlaf_tpu.health.NotPositiveDefiniteError` instead of letting
-    NaNs flow into the triangular solves."""
+    NaNs flow into the triangular solves.
+
+    ``refine_to='input'`` appends up to ``refine_sweeps`` driver-level
+    residual-correction sweeps (``algorithms.refine``): the companion of
+    the bf16 split-GEMM compute tiers (``tune.gemm_precision``), whose
+    f32-class trailing updates it restores to the input dtype's residual
+    level.  The residual GEMMs run at full precision
+    (``gemm_precision_scope('default')``); the corrections re-use the
+    fast-tier Cholesky factor.  Needs pre-factorization snapshots of A
+    and B (both are donated by the fast path), so it costs two extra
+    buffers + one Hermitian GEMM and two triangular solves per sweep."""
+    from dlaf_tpu.algorithms import refine as _refine
+
+    _refine.validate_refine_to(refine_to)
     _check_solve_geometry("positive_definite_solver", uplo, mat_a, mat_b)
+    snap = None
+    if refine_to is not None:
+        # astype is ALWAYS a fresh buffer: safe snapshots of the donated
+        # operands, and the max-norm must be read before A is factored over
+        snap = (mat_a.astype(mat_a.dtype), mat_b.astype(mat_b.dtype),
+                float(max_norm(mat_a, uplo)))
     if return_info or raise_on_failure:
         fac, info = cholesky_factorization(
             uplo, mat_a, return_info=True, raise_on_failure=raise_on_failure
         )
         x = cholesky_solver(uplo, fac, mat_b)
+        if snap is not None:
+            x = _posv_refined(uplo, fac, x, snap, refine_sweeps)
         return (x, info) if return_info else x
     fac = cholesky_factorization(uplo, mat_a)
-    return cholesky_solver(uplo, fac, mat_b)
+    x = cholesky_solver(uplo, fac, mat_b)
+    if snap is not None:
+        x = _posv_refined(uplo, fac, x, snap, refine_sweeps)
+    return x
+
+
+def _posv_refined(uplo, fac, x, snap, refine_sweeps):
+    """The ``refine_to='input'`` tail of ``positive_definite_solver``."""
+    from dlaf_tpu.algorithms.refine import refine_tolerance, residual_refine
+
+    a_full, b_full, anorm = snap
+    x, _ = residual_refine(
+        x,
+        # summa never donates its A/B operands and astype(B) is a fresh
+        # copy for the donated C accumulator
+        lambda xc: hermitian_multiplication(
+            t.LEFT, uplo, -1.0, a_full, xc, 1.0, b_full.astype(b_full.dtype)
+        ),
+        lambda r: cholesky_solver(uplo, fac, r),
+        tol=refine_tolerance(anorm, a_full.size.rows, a_full.dtype),
+        anorm=anorm,
+        max_sweeps=refine_sweeps,
+    )
+    return x
 
 
 @dataclass

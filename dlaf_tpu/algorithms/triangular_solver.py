@@ -73,7 +73,7 @@ def _trsm_left_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op, 
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
         # B[i, :] -= op(A)[i,k] @ X[k, :]
         with _scope("trsm.update"):
-            return b - jnp.einsum("iab,jbc->ijac", cp, xr)
+            return b - t.contract("iab,jbc->ijac", cp, xr)
 
     b = lax.fori_loop(0, mt, body, b)
     return coll.relocal(b)
@@ -122,7 +122,7 @@ def _trsm_right_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op,
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
         # B[:, j] -= X[:, k] @ op(A)[k, j]
         with _scope("trsm.update"):
-            return b - jnp.einsum("iab,jbc->ijac", xc, rp)
+            return b - t.contract("iab,jbc->ijac", xc, rp)
 
     b = lax.fori_loop(0, nt, body, b)
     return coll.relocal(b)
@@ -181,7 +181,7 @@ def _trsm_left_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             cp = jnp.where(remaining[:, None, None], cp, jnp.zeros_like(cp))
         with _scope("trsm.update"):
             bs = lax.dynamic_slice(b, (rs, 0, 0, 0), (L, g_b.ltc, g_b.mb, g_b.nb))
-            bs = bs - jnp.einsum("iab,jbc->ijac", cp, xr)
+            bs = bs - t.contract("iab,jbc->ijac", cp, xr)
             return lax.dynamic_update_slice(b, bs, (rs, 0, 0, 0))
 
     for s0, s1 in _spmd.halving_segments(mt):
@@ -244,7 +244,7 @@ def _trsm_right_bucketed_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
             rp = jnp.where(remaining[:, None, None], rp, jnp.zeros_like(rp))
         with _scope("trsm.update"):
             bs = lax.dynamic_slice(b, (0, cs, 0, 0), (g_b.ltr, C, g_b.mb, g_b.nb))
-            bs = bs - jnp.einsum("iab,jbc->ijac", xc, rp)
+            bs = bs - t.contract("iab,jbc->ijac", xc, rp)
             return lax.dynamic_update_slice(b, bs, (0, cs, 0, 0))
 
     for s0, s1 in _spmd.halving_segments(nt):
@@ -328,7 +328,7 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         a1 = a_tile(k, k1)
         lk1 = k1 // g_a.pr
         brow1 = _spmd.take_row(b, lk1, g_b)
-        upd1 = jnp.einsum("ab,jbc->jac", a1, xr)
+        upd1 = t.contract("ab,jbc->jac", a1, xr)
         brow1 = jnp.where(myr == k1 % g_a.pr, brow1 - upd1, brow1)
         b = _spmd.put_row(b, brow1, lk1)
         xr1 = solve_row(b, k1)  # lookahead: overlaps with the bulk below
@@ -336,7 +336,7 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         with _scope("trsm.update"):
             cp = panel(k)
             cp = jnp.where((gi == k1)[:, None, None], jnp.zeros_like(cp), cp)
-            b = b - jnp.einsum("iab,jbc->ijac", cp, xr)
+            b = b - t.contract("iab,jbc->ijac", cp, xr)
         return b, xr1
 
     k0 = 0 if forward else mt - 1
@@ -363,7 +363,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
 
     da, db = mat_a.dist, mat_b.dist
     key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha),
-           _spmd.trsm_trace_key(), _spmd.serve_trace_key())
+           _spmd.trsm_trace_key(), _spmd.serve_trace_key(),
+           _spmd.gemm_precision_trace_key())
     if key not in _local_cache:
 
         @jax.jit
@@ -381,7 +382,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
 @origin_transparent
 def triangular_solver(
     side: str, uplo: str, op: str, diag: str, alpha, mat_a: DistributedMatrix,
-    mat_b: DistributedMatrix, backend: str = "auto"
+    mat_b: DistributedMatrix, backend: str = "auto",
+    refine_to: str | None = None, refine_sweeps: int = 2,
 ) -> DistributedMatrix:
     """B := solution X of op(A) X = alpha B (Left) / X op(A) = alpha B (Right).
 
@@ -389,7 +391,21 @@ def triangular_solver(
     updated B matrix (functional in-place).  ``backend='auto'`` uses one
     dense XLA triangular_solve on 1x1 grids, the distributed SPMD kernel
     otherwise; 'distributed' forces the kernel.
-    """
+
+    ``refine_to='input'`` appends up to ``refine_sweeps`` residual
+    corrections (``algorithms.refine``; companion of the bf16 split-GEMM
+    tiers): r = alpha B - op(A)-apply(X) at full precision, correction
+    d = solve(r) at the ambient tier, X += d.  Needs a pre-solve snapshot
+    of B (the solve donates it)."""
+    if refine_to is not None:
+        from dlaf_tpu.algorithms.refine import validate_refine_to
+
+        validate_refine_to(refine_to)
+        b_snap = mat_b.astype(mat_b.dtype)  # fresh buffer: solve donates B
+        x = triangular_solver(side, uplo, op, diag, alpha, mat_a, mat_b,
+                              backend=backend)
+        return _trsm_refined(side, uplo, op, diag, alpha, mat_a, x, b_snap,
+                             backend, refine_sweeps)
     if mat_a.size.rows != mat_a.size.cols:
         raise ValueError("trsm: A must be square")
     if mat_a.block_size.rows != mat_a.block_size.cols:
@@ -429,9 +445,39 @@ def triangular_solver(
         else None
     )
     key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), _spmd.trsm_trace_key(), g_a, g_b,
-           lookahead, ratio, coll.collectives_trace_key(), _spmd.serve_trace_key())
+           lookahead, ratio, coll.collectives_trace_key(), _spmd.serve_trace_key(),
+           _spmd.gemm_precision_trace_key())
     if key not in _cache:
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
         _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))
     with blas3_precision():
         return mat_b._inplace(_cache[key](mat_a.data, mat_b.data))
+
+
+def _trsm_refined(side, uplo, op, diag, alpha, mat_a, x, b_snap, backend,
+                  refine_sweeps):
+    """The ``refine_to='input'`` tail of ``triangular_solver``: residual
+    r = alpha B - op(A)-apply(X) via ``triangular_multiplication`` (full
+    precision), correction d = solve(r) at the ambient tier."""
+    from dlaf_tpu.algorithms.multiplication import triangular_multiplication
+    from dlaf_tpu.algorithms.norm import max_norm
+    from dlaf_tpu.algorithms.refine import refine_tolerance, residual_refine
+
+    anorm = max_norm(mat_a, uplo)
+
+    def residual(xc):
+        # trmm treats X as a summa operand (never donated) and returns a
+        # fresh matrix; the subtraction is elementwise, no contraction
+        ax = triangular_multiplication(side, uplo, op, diag, 1.0, mat_a, xc)
+        return ax.like(alpha * b_snap.data.astype(ax.dtype) - ax.data)
+
+    x, _ = residual_refine(
+        x,
+        residual,
+        lambda r: triangular_solver(side, uplo, op, diag, 1.0, mat_a, r,
+                                    backend=backend),
+        tol=refine_tolerance(anorm, mat_a.size.rows, x.dtype),
+        anorm=anorm,
+        max_sweeps=refine_sweeps,
+    )
+    return x

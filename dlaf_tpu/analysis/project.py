@@ -40,6 +40,8 @@ KNOWN_SAFE_CALLEES = frozenset({
     "print_config",
     "maybe_dump",        # debug HDF5 dumps: host-side, gated on debug_dump_*
     "default_cache",     # serve cache construction reads capacity, not trace state
+    "load_hdf5",         # host-side checkpoint I/O: default_block_size only picks
+                         # a distribution, which every key carries via Geometry
 })
 
 GTP_NAMES = frozenset({"get_tune_parameters", "_gtp"})
@@ -137,7 +139,8 @@ class Project:
     def _index_module(self, f) -> None:
         imports: dict[str, str] = {}
         toplevel: dict[str, tuple] = {}
-        for node in f.tree.body:
+
+        def _record_import(node) -> None:
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     imports[alias.asname or alias.name.split(".")[0]] = (
@@ -153,6 +156,18 @@ class Project:
                     if alias.name == "*":
                         continue
                     imports[alias.asname or alias.name] = f"{base}.{alias.name}"
+
+        # function-local (lazy) imports first: the codebase defers ``tune``
+        # imports into kernel builders to break import cycles, and a knob
+        # read behind ``from dlaf_tpu.tune import resolved_gemm_precision``
+        # inside ``ops.tile.contract`` must still resolve (DLAF001).
+        # Top-level imports are recorded second so they win alias collisions.
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)) and node not in f.tree.body:
+                _record_import(node)
+        for node in f.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                _record_import(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 toplevel[node.name] = ("func", node.name)
             elif isinstance(node, ast.ClassDef):
@@ -276,9 +291,14 @@ class Project:
                     qn = f"{mod}:{attr}"
                     if qn in self.functions:
                         return qn
+                    # function indexing runs module by module, so consult the
+                    # (complete) toplevel table rather than self.functions:
+                    # otherwise calls into modules indexed later never resolve
                     tl = self._toplevel.get(mod, {})
                     if attr in tl and tl[attr][0] == "dict":
                         return f"{mod}:#dict:{attr}"
+                    if attr in tl and tl[attr][0] == "func":
+                        return qn
                     # unknown attr of a known module: treat as opaque
                     return qn if attr.split(".")[-1] in GTP_NAMES else None
             return None

@@ -28,6 +28,14 @@ through local assignments — knobs behind derived elements such as
 
 Anything read but not covered is a finding naming the knob and a witness
 read location.
+
+The round-9 stress test of this rule was ``tune.gemm_precision``: the
+split-GEMM tier is read at trace time inside ``ops.tile.contract`` (a
+function-local lazy import, several call hops below every builder), so
+every compiled-kernel key in the tree must carry
+``_spmd.gemm_precision_trace_key()`` — finding the three sites that
+didn't required fixing the project indexer twice (lazy imports, and
+cross-module call resolution through the complete top-level table).
 """
 from __future__ import annotations
 

@@ -100,6 +100,129 @@ def trsm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
     )
 
 
+# ----------------------------------------------------------------- split-GEMM
+# Explicit mixed-precision compute tiers for the trailing-update contractions
+# (arXiv:2112.09017): each real operand is decomposed into bf16 slices
+# (head + residual chain), the O(k^2)-pruned cross-products run as bf16
+# matmuls accumulated in f32 (`preferred_element_type`), and the partials
+# recombine at the operand dtype.  'bf16x3' = 2 slices / 3 products (the MXU
+# 3-pass scheme), 'bf16x6' = 3 slices / 6 products (double-split for f64
+# operands).  Both give ~f32-class forward error — the f32 accumulation
+# floors the error at ~k*2^-24, so f64 callers that need target-precision
+# residuals pair a fast-tier factorization with driver-level refinement
+# (algorithms/refine.py `refine_to=`).  Complex operands route through four
+# real split contracts (float-pair view).
+
+#: contraction dim below which 'auto' keeps the default tier: split slicing
+#: costs 3-6 bf16 passes + decomposition, only worth it once the MXU matmul
+#: dominates (tritonBLAS-style analytical pick, no per-request search)
+AUTO_SPLIT_MIN_K = 512
+
+_SPLIT_SLICES = {"bf16x3": 2, "bf16x6": 3}
+
+
+def _bf16_slices(x, nslices: int):
+    """Head + residual bf16 slice chain of a REAL array: s0 = bf16(x),
+    s_i = bf16(x - s0 - ... - s_{i-1}) with the residuals taken at x's
+    dtype.  sum(s_i) captures ~8*nslices mantissa bits of x."""
+    slices = []
+    r = x
+    for i in range(nslices):
+        s = r.astype(jnp.bfloat16)
+        slices.append(s)
+        if i + 1 < nslices:
+            r = r - s.astype(r.dtype)
+    return slices
+
+
+def _split_contract_real(subscripts, a, b, nslices: int, out_dtype):
+    asl = _bf16_slices(a, nslices)
+    bsl = _bf16_slices(b, nslices)
+    # prune to slice-index sum <= nslices - 1 (dropped terms are below the
+    # captured mantissa); accumulate smallest cross-terms first so the head
+    # product lands on an already-settled tail
+    terms = sorted(
+        ((i, j) for i in range(nslices) for j in range(nslices) if i + j < nslices),
+        key=lambda ij: ij[0] + ij[1],
+        reverse=True,
+    )
+    acc = None
+    for i, j in terms:
+        p = jnp.einsum(
+            subscripts, asl[i], bsl[j], preferred_element_type=jnp.float32
+        ).astype(out_dtype)
+        acc = p if acc is None else acc + p
+    return acc
+
+
+def _split_contract(subscripts, a, b, nslices: int, dtype):
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        # float-pair view: (ar + i ai)(br + i bi) as four real split contracts
+        rdt = jnp.finfo(dtype).dtype
+        ar, ai = jnp.real(a).astype(rdt), jnp.imag(a).astype(rdt)
+        br, bi = jnp.real(b).astype(rdt), jnp.imag(b).astype(rdt)
+        rr = _split_contract_real(subscripts, ar, br, nslices, rdt)
+        ii = _split_contract_real(subscripts, ai, bi, nslices, rdt)
+        ri = _split_contract_real(subscripts, ar, bi, nslices, rdt)
+        ir = _split_contract_real(subscripts, ai, br, nslices, rdt)
+        return lax.complex(rr - ii, ri + ir).astype(dtype)
+    return _split_contract_real(subscripts, a, b, nslices, dtype)
+
+
+def _auto_tier(subscripts, a, b, dtype) -> str:
+    """Analytical 'auto' resolution, per contraction site: split only on
+    accelerator backends with a large contracted extent, tier picked by
+    dtype width.  Depends on static shapes and the process backend only, so
+    cache keys carrying the raw 'auto' stay sound."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return "default"
+    ins, out = subscripts.replace(" ", "").split("->")
+    sa, sb = ins.split(",")
+    extents = {}
+    for labels, arr in ((sa, a), (sb, b)):
+        core = labels.replace("...", "")
+        for lbl, ext in zip(core, arr.shape[arr.ndim - len(core):]):
+            extents[lbl] = ext
+    k = 1
+    for lbl, ext in extents.items():
+        if lbl not in out:
+            k *= ext
+    if k < AUTO_SPLIT_MIN_K:
+        return "default"
+    return "bf16x6" if jnp.finfo(dtype).bits >= 64 else "bf16x3"
+
+
+def contract(subscripts, a, b, tier: str | None = None):
+    """Tier-aware two-operand contraction — the trailing-update primitive
+    behind :func:`gemm`/:func:`herk`/:func:`hemm`/:func:`trmm` and the
+    distributed algorithms' einsum updates (algorithms/_spmd.py callers).
+
+    ``tier=None`` resolves ``tune.gemm_precision`` (including the ambient
+    ``tune.gemm_precision_scope`` override) at TRACE time — every compiled
+    kernel that traces a contract must carry
+    ``_spmd.gemm_precision_trace_key()`` in its cache key (DLAF001).
+    'default' is a plain ``jnp.einsum`` at the operand dtype, bit-identical
+    to the pre-tier code; split tiers follow the module comment above.
+    Integer and sub-f32 float operands are never split."""
+    if tier is None:
+        from dlaf_tpu.tune import resolved_gemm_precision
+
+        tier = resolved_gemm_precision()
+    dtype = jnp.result_type(a, b)
+    if tier == "auto":
+        tier = _auto_tier(subscripts, a, b, dtype)
+    nslices = _SPLIT_SLICES.get(tier)
+    if (
+        nslices is None
+        or not jnp.issubdtype(dtype, jnp.inexact)
+        or jnp.finfo(dtype).bits < 32
+    ):
+        return jnp.einsum(subscripts, a, b)
+    return _split_contract(subscripts, a, b, nslices, dtype)
+
+
 def trmm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
     """B := alpha * op(A) B (Left) or alpha * B op(A) (Right), A triangular."""
     tri = jnp.tril(a) if uplo == LOWER else jnp.triu(a)
@@ -107,12 +230,19 @@ def trmm(side: str, uplo: str, op: str, diag: str, alpha, a, b):
         eye = jnp.eye(tri.shape[-1], dtype=tri.dtype)
         tri = tri - tri * eye + eye  # replace diagonal with ones
     tri = op_tile(tri, op)
-    return alpha * (tri @ b if side == LEFT else b @ tri)
+    prod = (
+        contract("...ab,...bc->...ac", tri, b)
+        if side == LEFT
+        else contract("...ab,...bc->...ac", b, tri)
+    )
+    return alpha * prod
 
 
 def gemm(opa: str, opb: str, alpha, a, b, beta, c):
     """C := alpha op(A) op(B) + beta C (tile::gemm)."""
-    return alpha * (op_tile(a, opa) @ op_tile(b, opb)) + beta * c
+    return alpha * contract(
+        "...ab,...bc->...ac", op_tile(a, opa), op_tile(b, opb)
+    ) + beta * c
 
 
 def herk(uplo: str, op: str, alpha, a, beta, c):
@@ -122,12 +252,17 @@ def herk(uplo: str, op: str, alpha, a, beta, c):
     storage rather than triangle-only updates (TPU-friendlier than the
     reference's triangle-only semantics)."""
     oa = op_tile(a, op)
-    return alpha * (oa @ _adj(oa)) + beta * c
+    return alpha * contract("...ab,...bc->...ac", oa, _adj(oa)) + beta * c
 
 
 def hemm(side: str, uplo: str, alpha, a, b, beta, c):
     """C := alpha A B + beta C with A Hermitian (full-storage assumed)."""
-    return alpha * (a @ b if side == LEFT else b @ a) + beta * c
+    prod = (
+        contract("...ab,...bc->...ac", a, b)
+        if side == LEFT
+        else contract("...ab,...bc->...ac", b, a)
+    )
+    return alpha * prod + beta * c
 
 
 def lange_max(a):

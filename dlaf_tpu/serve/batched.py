@@ -203,7 +203,8 @@ def _trace_knobs(variant: str) -> tuple:
 
     ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
     return (variant, ratio, bool(get_tune_parameters().trsm_lookahead),
-            _spmd.trsm_trace_key(), coll.collectives_trace_key())
+            _spmd.trsm_trace_key(), coll.collectives_trace_key(),
+            _spmd.gemm_precision_trace_key())
 
 
 def _dist_for(n_bucket: int, mb: int, grid: Grid, shard_batch: bool, k: int | None = None):

@@ -13,6 +13,8 @@ surviving knobs control algorithm shape choices.
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 from dataclasses import dataclass, field, fields
 
@@ -64,6 +66,37 @@ class TuneParameters:
       round-1 on-chip residual checks passed — so throughput users change
       nothing; accuracy-critical users set 'float32' (or 'high' ==
       bf16_3x) per call or via DLAF_TPU_BLAS3_MATMUL_PRECISION.
+      Both ``*_matmul_precision`` knobs are XLA dot-precision HINTS —
+      ``jax.default_matmul_precision`` contexts jit itself keys on.  The
+      explicit split-GEMM tier below (``gemm_precision``) supersedes them
+      for the trailing-update contractions and is the one to reach for
+      first; the hint knobs remain for the non-contract matmuls (panel
+      factorizations, lax.linalg calls) and are validated through the
+      same :func:`validate_matmul_precision` helper.
+    - ``gemm_precision``: explicit split-GEMM compute tier for the
+      trailing-update contractions (``ops.tile.contract`` — GEMM / HERK /
+      HEMM / TRMM and every distributed trailing update reached through
+      ``algorithms/_spmd.py``).  'default' = plain einsum at the operand
+      dtype (bit-identical to the pre-tier code); 'bf16x3' = each real
+      operand split into 2 bf16 slices (head + residual), 3 pruned
+      cross-products accumulated in f32 (the TPU linear-algebra paper's
+      3-pass scheme, arXiv:2112.09017) — ~f32-class forward error for
+      f32 data at bf16 MXU throughput; 'bf16x6' = 3 slices / 6 products,
+      the double-split used for f64 operands (f32-class accuracy — the
+      f32 accumulation floors the error at ~k*2^-24; driver-level
+      refinement (``refine_to=`` on positive_definite_solver /
+      triangular_solver) restores target-precision residuals); 'auto'
+      resolves analytically per contraction from static shape + backend
+      (accelerator AND contracted extent >= 512 -> split tier by dtype,
+      CPU -> default; no per-request search, the tritonBLAS argument).
+      Complex dtypes route through four real split contracts
+      (float-pair view); integer / sub-f32 operands are never split.
+      Read at TRACE time: every compiled-kernel cache key carries
+      ``_spmd.gemm_precision_trace_key()`` (DLAF001 enforces — a knob
+      outside the key is a dead knob), which also folds in the ambient
+      :func:`gemm_precision_scope` override that refinement uses to run
+      its residual GEMMs at full precision.  Values outside
+      {default, bf16x3, bf16x6, auto} raise health.ConfigurationError.
     - ``cholesky_lookahead``: use the lookahead SPMD kernel (panel k+1
       overlapped with the bulk trailing update — benefits multi-chip
       meshes; the bucketed kernel is the single-chip default).
@@ -178,6 +211,9 @@ class TuneParameters:
     blas3_matmul_precision: str = field(
         default_factory=lambda: _env("blas3_matmul_precision", "default", str)
     )
+    gemm_precision: str = field(
+        default_factory=lambda: _env("gemm_precision", "default", str)
+    )
     gen_to_std_backend: str = field(
         default_factory=lambda: _env("gen_to_std_backend", "composed", str)
     )
@@ -229,11 +265,87 @@ class TuneParameters:
                 raise ValueError(f"unknown tune parameter {k!r}")
             if k == "collectives_impl":
                 validate_collectives_impl(v)
+            elif k == "gemm_precision":
+                validate_gemm_precision(v)
+            elif k in ("blas3_matmul_precision", "eigensolver_matmul_precision"):
+                validate_matmul_precision(v, knob=k)
             setattr(self, k, v)
         return self
 
 
 COLLECTIVES_IMPLS = ("psum", "v2", "pallas", "auto")
+GEMM_PRECISIONS = ("default", "bf16x3", "bf16x6", "auto")
+
+
+def validate_gemm_precision(value) -> str:
+    """Reject split-GEMM tiers outside the documented domain — same
+    fail-fast shape as :func:`validate_collectives_impl`: checked on
+    explicit ``update(gemm_precision=...)`` AND when ``ops.tile.contract``
+    resolves the knob at trace time, so a typo'd ``DLAF_TPU_GEMM_PRECISION``
+    env value surfaces as a ConfigurationError, not a deep-trace failure."""
+    if value not in GEMM_PRECISIONS:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            f"gemm_precision must be one of {GEMM_PRECISIONS}, "
+            f"got {value!r} (env DLAF_TPU_GEMM_PRECISION)"
+        )
+    return value
+
+
+def validate_matmul_precision(value, knob: str = "matmul_precision") -> str:
+    """Reject matmul-precision hint strings outside the domain JAX accepts
+    (after alias normalization) with a structured error naming the knob."""
+    if normalize_matmul_precision(value) not in MATMUL_PRECISIONS:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            f"{knob} must be one of {sorted(MATMUL_PRECISIONS)} or an alias "
+            f"{sorted(_PRECISION_ALIASES)}, got {value!r} "
+            f"(env DLAF_TPU_{knob.upper()})"
+        )
+    return value
+
+
+# the ambient split-GEMM tier override: refinement loops (algorithms/refine.py)
+# run their residual GEMMs under gemm_precision_scope('default') so the
+# correction sweeps measure against full-precision residuals while the
+# factorization/solve kernels keep the fast tier.  Trace state: the override
+# is part of gemm_precision_trace_key(), so scoped and unscoped traces of the
+# same kernel can never alias one executable.
+_gemm_precision_override: contextvars.ContextVar = contextvars.ContextVar(
+    "dlaf_tpu_gemm_precision_override", default=None
+)
+
+
+@contextlib.contextmanager
+def gemm_precision_scope(tier: str):
+    """Force the split-GEMM tier for contractions traced inside the scope,
+    overriding ``tune.gemm_precision`` (see ``_gemm_precision_override``)."""
+    validate_gemm_precision(tier)
+    token = _gemm_precision_override.set(tier)
+    try:
+        yield tier
+    finally:
+        _gemm_precision_override.reset(token)
+
+
+def resolved_gemm_precision() -> str:
+    """The split-GEMM tier in effect at this trace point: the ambient
+    :func:`gemm_precision_scope` override when active, else the tune knob
+    (validated — fail-fast on a typo'd env value).  'auto' is returned
+    as-is: it resolves per contraction site from static shape + backend
+    (``ops.tile.contract``), both of which are already cache-key state."""
+    override = _gemm_precision_override.get()
+    if override is not None:
+        return override
+    return validate_gemm_precision(get_tune_parameters().gemm_precision)
+
+
+#: bf16 MXU passes per output element relative to one fused pass — the
+#: modeled-flops multiplier obs/bench attribute the split tiers' extra work
+#: with (report_metrics.py precision roll-up).
+GEMM_TIER_FLOP_MULTIPLIER = {"default": 1, "auto": 1, "bf16x3": 3, "bf16x6": 6}
 
 
 def validate_collectives_impl(value) -> str:
@@ -312,17 +424,26 @@ def print_config(file=None) -> None:
 # ('high' == three bf16 passes on TPU MXU, 'highest'/'float32' == six)
 _PRECISION_ALIASES = {"bfloat16_3x": "high", "bf16_3x": "high", "f32": "float32"}
 
+#: post-normalization domain of the *_matmul_precision hint knobs — the
+#: strings jax.default_matmul_precision accepts plus the ''/'default' no-op
+MATMUL_PRECISIONS = frozenset(
+    {"", "default", "bfloat16", "tensorfloat32", "high", "float32", "highest"}
+)
+
 
 def normalize_matmul_precision(p: str) -> str:
     return _PRECISION_ALIASES.get(p, p)
 
 
-def matmul_precision(p: str):
+def matmul_precision(p: str, knob: str = "matmul_precision"):
     """Context manager for a matmul-precision string ('' / 'default' =
-    no-op, keeping JAX's global setting; aliases normalized)."""
+    no-op, keeping JAX's global setting; aliases normalized) — the single
+    resolution point for the per-family precision knobs: every value is
+    validated here (fail-fast ConfigurationError on a typo'd env value,
+    same shape as validate_collectives_impl at resolve time)."""
     import contextlib
 
-    p = normalize_matmul_precision(p)
+    p = normalize_matmul_precision(validate_matmul_precision(p, knob=knob))
     if p in ("", "default"):
         return contextlib.nullcontext()
     import jax
@@ -333,4 +454,16 @@ def matmul_precision(p: str):
 def blas3_precision():
     """Context manager applying ``blas3_matmul_precision`` around a BLAS-3
     kernel call."""
-    return matmul_precision(get_tune_parameters().blas3_matmul_precision)
+    return matmul_precision(
+        get_tune_parameters().blas3_matmul_precision, knob="blas3_matmul_precision"
+    )
+
+
+def eigensolver_precision():
+    """Context manager applying ``eigensolver_matmul_precision`` around an
+    eigensolver pipeline stage — the eigensolver-family counterpart of
+    :func:`blas3_precision`, resolving through the same validated helper."""
+    return matmul_precision(
+        get_tune_parameters().eigensolver_matmul_precision,
+        knob="eigensolver_matmul_precision",
+    )

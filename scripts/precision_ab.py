@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Split-GEMM precision-tier A/B: POSV throughput/accuracy per tier.
+
+Usage: python scripts/precision_ab.py [--m 2048] [--nrhs 16] [--mb 256]
+           [--nruns 2] [--grid RxC] [--tiers default,bf16x3,bf16x3+refine,bf16x6]
+           [--probe-budget 20] [--out ab.json] [--metrics ab.jsonl]
+
+For each tier: one ``DeviceWatchdog`` probe (the bench.py liveness
+protocol — a dead TPU window classifies as ``DeviceUnresponsiveError``
+and the tier's row is stale-flagged instead of hanging the campaign),
+then ``nruns`` timed ``positive_definite_solver`` runs at that
+``tune.gemm_precision``.  A ``+refine`` suffix (e.g. ``bf16x3+refine``)
+adds ``refine_to='input'`` so the row shows what the residual-correction
+sweeps cost AND what accuracy they buy: every row carries the measured
+normalized residual next to GFlop/s and the modeled emulation GFlop/s
+(``tune.GEMM_TIER_FLOP_MULTIPLIER`` — bf16x3 issues 3 bf16 products per
+logical one, bf16x6 issues 6).  Rows land in ``--out`` as JSON and, with
+``--metrics``, in the obs.metrics JSONL stream ('run' + 'bench' records
+per tier) for scripts/report_metrics.py.
+
+Runs on the CPU mesh too (where the split tiers only validate accuracy,
+not speed) — the real A/B is the precision stage of scripts/tpu_day.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TIERS = ("default", "bf16x3", "bf16x3+refine", "bf16x6")
+
+
+def _bench_tier(spec, grid, args, om):
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu import tune
+    from dlaf_tpu.algorithms.solver import positive_definite_solver
+    from dlaf_tpu.health import DeviceUnresponsiveError
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+    from dlaf_tpu.resilience import DeviceWatchdog
+
+    tier, _, suffix = spec.partition("+")
+    refine = "input" if suffix == "refine" else None
+    row = {"tier": spec, "gemm_precision": tier, "refined": bool(refine),
+           "m": args.m, "nrhs": args.nrhs, "mb": args.mb,
+           "grid": list(grid.grid_size), "nruns": args.nruns}
+    try:
+        row["probe_s"] = DeviceWatchdog(budget_s=args.probe_budget).probe()
+    except DeviceUnresponsiveError as exc:
+        row.update(alive=False, stale=True, error=str(exc))
+        print(f"[{spec}] device unresponsive, row stale-flagged: {exc}")
+        return row
+    row["alive"] = True
+
+    a = tu.random_hermitian_pd(args.m, np.float32, seed=11)
+    b = tu.random_matrix(args.m, args.nrhs, np.float32, seed=12)
+    anorm = float(np.max(np.abs(a)))
+    times, x = [], None
+    for i in range(-1, args.nruns):  # one warmup (the compile) + timed runs
+        tune.get_tune_parameters().update(gemm_precision=tier)
+        mat_a = DistributedMatrix.from_global(grid, np.tril(a), (args.mb, args.mb))
+        mat_b = DistributedMatrix.from_global(grid, b, (args.mb, args.mb))
+        mat_a.data.block_until_ready()
+        t0 = time.perf_counter()
+        x = positive_definite_solver("L", mat_a, mat_b, refine_to=refine)
+        x.data.block_until_ready()
+        dt = time.perf_counter() - t0
+        if i >= 0:
+            times.append(dt)
+    best = min(times)
+    xh = np.asarray(x.to_global())
+    residual = float(
+        np.max(np.abs(b - a @ xh))
+        / (anorm * max(float(np.max(np.abs(xh))), 1e-30))
+    )
+    flops = args.m**3 / 3 + 4 * args.m**2 * args.nrhs
+    gflops = flops / best / 1e9
+    # the tier's emulated GEMMs issue multiplier-x bf16 products per
+    # logical product: modeled hardware throughput of the emulation
+    modeled = gflops * tune.GEMM_TIER_FLOP_MULTIPLIER[tier]
+    row.update(seconds=best, gflops=gflops, modeled_gflops=modeled,
+               residual=residual)
+    print(f"[{spec}] {best:.4f}s {gflops:.2f} GFlop/s "
+          f"(modeled {modeled:.2f}) residual {residual:.2e}")
+    if om is not None:
+        om.emit("run", name=f"posv_{spec}", run_index=0, seconds=best,
+                gflops=gflops, m=args.m, mb=args.mb,
+                grid=list(grid.grid_size), dtype="s",
+                gemm_precision=tier, refined=bool(refine))
+        om.emit("bench", record={"metric": f"posv_gflops_{spec}",
+                                 "value": gflops, "unit": "GFlop/s",
+                                 "gemm_precision": tier,
+                                 "modeled_gflops": modeled,
+                                 "residual": residual,
+                                 "refined": bool(refine)})
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--m", type=int, default=2048)
+    ap.add_argument("--nrhs", type=int, default=16)
+    ap.add_argument("--mb", type=int, default=256)
+    ap.add_argument("--nruns", type=int, default=2)
+    ap.add_argument("--grid", default="", help="RxC (default: most-square)")
+    ap.add_argument("--tiers", default=",".join(TIERS))
+    ap.add_argument("--probe-budget", type=float, default=20.0)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--metrics", default="")
+    args = ap.parse_args(argv)
+
+    from dlaf_tpu import tune
+    from dlaf_tpu.comm.grid import Grid, Size2D
+    from dlaf_tpu.obs import metrics as om_mod
+
+    om = None
+    if args.metrics:
+        om_mod.enable(args.metrics)
+        om_mod.emit_run_meta("precision_ab")
+        om_mod.emit_config()
+        om = om_mod
+
+    if args.grid:
+        r, c = (int(v) for v in args.grid.lower().split("x"))
+        grid = Grid.create(Size2D(r, c))
+    else:
+        grid = Grid.create()
+
+    tp = tune.get_tune_parameters()
+    saved = tp.gemm_precision
+    try:
+        results = [
+            _bench_tier(s.strip(), grid, args, om)
+            for s in args.tiers.split(",") if s.strip()
+        ]
+    finally:
+        tp.update(gemm_precision=saved)
+        if om is not None:
+            om_mod.close()
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(results, fh, indent=2)
+        print(f"rows written to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

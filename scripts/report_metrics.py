@@ -208,6 +208,50 @@ def summarize(path: str) -> int:
             print(f"   heev: {h.get('metric', '?')} {h.get('seconds', '?')}s "
                   f"{h.get('gflops', '?')} GFlop/s")
 
+    # precision roll-up: any record that carries a gemm_precision label
+    # (precision_ab rows, bench posv_precision columns) lands in one table:
+    # measured GFlop/s, the modeled emulation GFlop/s (the tier's
+    # GEMM_TIER_FLOP_MULTIPLIER x as many bf16 products), and the residual
+    # the throughput was bought at
+    prec = []
+    for r in by_kind.get("run", []):
+        if "gemm_precision" in r:
+            prec.append({"label": r.get("name", "?"),
+                         "tier": r["gemm_precision"],
+                         "gflops": r.get("gflops"),
+                         "refined": r.get("refined", False)})
+    for r in benches:
+        rec = r["record"]
+        if "gemm_precision" in rec:
+            prec.append({"label": rec.get("metric", "?"),
+                         "tier": rec["gemm_precision"],
+                         "gflops": rec.get("value"),
+                         "modeled": rec.get("modeled_gflops"),
+                         "residual": rec.get("residual"),
+                         "refined": rec.get("refined", False)})
+        for col in ("default", "bf16x3_refined"):
+            sub = rec.get("posv_precision", {}).get(col)
+            if sub:
+                prec.append({"label": f"{rec['posv_precision'].get('metric', '?')}:{col}",
+                             "tier": sub.get("gemm_precision", "?"),
+                             "gflops": sub.get("gflops"),
+                             "residual": sub.get("residual"),
+                             "refined": sub.get("refine_to") is not None})
+    if prec:
+        tiers = defaultdict(int)
+        for p in prec:
+            tiers[p["tier"]] += 1
+        print(f"-- precision ({len(prec)} records: "
+              + ", ".join(f"{t} x{n}" for t, n in sorted(tiers.items())) + "):")
+        print(f"   {'label':36s} {'tier':8s} {'GFlop/s':>9s} "
+              f"{'modeled':>9s} {'residual':>10s} {'refined':>7s}")
+        for p in prec:
+            gf = f"{p['gflops']:9.2f}" if p.get("gflops") is not None else f"{'-':>9s}"
+            md = f"{p['modeled']:9.2f}" if p.get("modeled") is not None else f"{'-':>9s}"
+            rs = f"{p['residual']:10.2e}" if p.get("residual") is not None else f"{'-':>10s}"
+            print(f"   {p['label']:36s} {p['tier']:8s} {gf} {md} {rs} "
+                  f"{'yes' if p['refined'] else 'no':>7s}")
+
     health = by_kind.get("health", [])
     if health:
         counts = defaultdict(int)

@@ -92,6 +92,14 @@ timeout 900 python -m dlaf_tpu.miniapp.miniapp_suite heev_mixed \
 timeout 900 python scripts/collectives_ab.py --m 8192 --mb 512 --nruns 2 \
   --out "$OUT/05_collectives_ab.json" --metrics "$OUT/05_collectives_ab.jsonl" \
   > "$OUT/05_collectives_ab.log" 2>&1
+#    (g) split-GEMM precision tiers: default/bf16x3/bf16x3+refine/bf16x6
+#        POSV A/B (watchdog-probed per tier; GFlop/s + modeled emulation
+#        GFlop/s + residual per row).  THE decision gate for promoting the
+#        bf16 tiers into gemm_precision 'auto' on real MXUs — the CPU-mesh
+#        numbers only validated accuracy, never the speedup.
+timeout 900 python scripts/precision_ab.py --m 4096 --mb 512 --nrhs 16 --nruns 2 \
+  --out "$OUT/05_precision_ab.json" --metrics "$OUT/05_precision_ab.jsonl" \
+  > "$OUT/05_precision_ab.log" 2>&1
 
 # 6. one profiler trace for the record
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \

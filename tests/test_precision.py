@@ -1,0 +1,251 @@
+"""Split-GEMM compute tiers (``tune.gemm_precision``) and driver-level
+iterative refinement (``refine_to=``): tier resolution and scope override,
+contract round-trip/error bounds, end-to-end POSV/TRSM residual parity
+after refinement, and the cache-key discipline (a knob outside the key is
+a dead knob)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu import health, tune
+from dlaf_tpu.algorithms import _spmd
+from dlaf_tpu.algorithms import multiplication as mul
+from dlaf_tpu.algorithms.refine import (
+    refine_tolerance,
+    residual_refine,
+    validate_refine_to,
+)
+from dlaf_tpu.algorithms.solver import positive_definite_solver
+from dlaf_tpu.algorithms.triangular_solver import triangular_solver
+from dlaf_tpu.matrix.matrix import DistributedMatrix
+from dlaf_tpu.ops import tile as t
+
+
+@pytest.fixture(autouse=True)
+def _restore_gemm_precision():
+    before = tune.get_tune_parameters().gemm_precision
+    yield
+    tune.get_tune_parameters().update(gemm_precision=before)
+
+
+def _ab(m, k, n, dtype, seed=0):
+    a = tu.random_matrix(m, k, dtype, seed=seed)
+    b = tu.random_matrix(k, n, dtype, seed=seed + 1)
+    return a, b
+
+
+def _relerr(got, ref):
+    return float(np.max(np.abs(np.asarray(got) - ref)) / np.max(np.abs(ref)))
+
+
+# ---------------------------------------------------------------- contract
+
+
+@pytest.mark.parametrize("dtype", tu.ELEMENT_TYPES, ids=str)
+def test_contract_default_bit_identical(dtype):
+    """'default' is the legacy einsum path, bit-for-bit."""
+    a, b = _ab(48, 96, 32, dtype, seed=11)
+    got = t.contract("ab,bc->ac", a, b, tier="default")
+    assert np.array_equal(np.asarray(got), np.asarray(jnp.einsum("ab,bc->ac", a, b)))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64], ids=str)
+def test_contract_bf16x3_error_bound(dtype):
+    """bf16x3 lands within a small multiple of f32 rounding (measured
+    ~4e-6 at k=256) — far better than a plain bf16 product."""
+    a, b = _ab(64, 256, 64, dtype, seed=5)
+    ref = np.einsum("ab,bc->ac", a.astype(np.complex128 if np.iscomplexobj(a) else np.float64),
+                    b.astype(np.complex128 if np.iscomplexobj(b) else np.float64))
+    err3 = _relerr(t.contract("ab,bc->ac", a, b, tier="bf16x3"), ref)
+    assert err3 < 5e-5
+    if not np.iscomplexobj(a):
+        import jax.numpy as jnp
+
+        bf16 = np.asarray(
+            jnp.einsum("ab,bc->ac", jnp.asarray(a, jnp.bfloat16), jnp.asarray(b, jnp.bfloat16),
+                       preferred_element_type=jnp.float32))
+        assert err3 < 0.05 * _relerr(bf16, ref)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64], ids=str)
+def test_contract_bf16x6_error_bound(dtype):
+    """bf16x6 (3 slices / 6 products) reaches f32-class accuracy even on
+    f64 operands (measured ~2e-7 at k=256); refinement, not the tier, is
+    what restores f64-class accuracy."""
+    a, b = _ab(64, 256, 64, dtype, seed=6)
+    ref = np.einsum("ab,bc->ac", a.astype(np.float64), b.astype(np.float64))
+    assert _relerr(t.contract("ab,bc->ac", a, b, tier="bf16x6"), ref) < 5e-6
+
+
+def test_contract_auto_resolves_default_on_cpu():
+    """'auto' never splits on the CPU backend (no bf16 matmul units)."""
+    a, b = _ab(32, 640, 32, np.float32, seed=7)  # k past AUTO_SPLIT_MIN_K
+    got = t.contract("ab,bc->ac", a, b, tier="auto")
+    assert np.array_equal(np.asarray(got), np.asarray(jnp.einsum("ab,bc->ac", a, b)))
+
+
+def test_contract_integer_operands_never_split():
+    a = np.arange(12, dtype=np.int32).reshape(3, 4)
+    b = np.arange(20, dtype=np.int32).reshape(4, 5)
+    got = t.contract("ab,bc->ac", a, b, tier="bf16x3")
+    assert np.array_equal(np.asarray(got), a @ b)
+
+
+def test_gemm_precision_scope_overrides_knob():
+    """The ContextVar scope wins over the tune knob (refinement residuals
+    run under scope('default') while the ambient tier stays fast)."""
+    a, b = _ab(32, 128, 32, np.float32, seed=9)
+    exact = np.asarray(jnp.einsum("ab,bc->ac", a, b))
+    tune.get_tune_parameters().update(gemm_precision="bf16x3")
+    assert tune.resolved_gemm_precision() == "bf16x3"
+    assert _spmd.gemm_precision_trace_key() == "bf16x3"
+    split = np.asarray(t.contract("ab,bc->ac", a, b))
+    assert not np.array_equal(split, exact)  # knob actually routed
+    with tune.gemm_precision_scope("default"):
+        assert tune.resolved_gemm_precision() == "default"
+        assert _spmd.gemm_precision_trace_key() == "default"
+        assert np.array_equal(np.asarray(t.contract("ab,bc->ac", a, b)), exact)
+    assert tune.resolved_gemm_precision() == "bf16x3"
+
+
+# -------------------------------------------------------------- validation
+
+
+def test_bad_gemm_precision_rejected():
+    with pytest.raises(health.ConfigurationError, match="gemm_precision"):
+        tune.get_tune_parameters().update(gemm_precision="fp8x9")
+    with pytest.raises(health.ConfigurationError):
+        tune.validate_gemm_precision("bf16")
+
+
+def test_bad_matmul_precision_rejected():
+    with pytest.raises(health.ConfigurationError, match="matmul_precision"):
+        tune.validate_matmul_precision("tensorfloat99")
+
+
+def test_bad_refine_to_rejected(grid_2x4):
+    with pytest.raises(health.ConfigurationError, match="refine_to"):
+        validate_refine_to("output")
+    a = tu.random_hermitian_pd(16, np.float32, seed=1)
+    b = tu.random_matrix(16, 4, np.float32, seed=2)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (4, 4))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (4, 4))
+    with pytest.raises(health.ConfigurationError, match="refine_to"):
+        positive_definite_solver("L", mat_a, mat_b, refine_to="target")
+    with pytest.raises(health.ConfigurationError, match="refine_to"):
+        triangular_solver("Left", "L", "N", "N", 1.0, mat_a, mat_b, refine_to="x")
+
+
+# ----------------------------------------------------- distributed parity
+
+
+@pytest.mark.parametrize("tier", ["bf16x3", "bf16x6"])
+def test_distributed_gemm_tier_parity(comm_grids, tier):
+    """Split tiers through the distributed GEMM driver stay within the
+    tier's error bound on every mesh shape (1x1, 2x2, 2x4, ...)."""
+    m, k, n, mb = 40, 48, 24, 8
+    a = tu.random_matrix(m, k, np.float32, seed=21)
+    b = tu.random_matrix(k, n, np.float32, seed=22)
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+    tune.get_tune_parameters().update(gemm_precision=tier)
+    for grid in comm_grids[:3]:
+        mat_a = DistributedMatrix.from_global(grid, a, (mb, mb))
+        mat_b = DistributedMatrix.from_global(grid, b, (mb, mb))
+        mat_c = DistributedMatrix.from_global(grid, np.zeros((m, n), np.float32), (mb, mb))
+        out = mul.general_multiplication("N", "N", 1.0, mat_a, mat_b, 0.0, mat_c)
+        assert _relerr(out.to_global(), ref) < (5e-5 if tier == "bf16x3" else 5e-6)
+
+
+# ------------------------------------------------- refined solver drivers
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64], ids=str)
+def test_posv_bf16x3_refined_meets_seed_bounds(grid_2x4, dtype):
+    """Acceptance: bf16x3 POSV with refine_to='input' meets the seed
+    residual bounds (same assert_near/tol_for as the default-tier seed
+    test in test_solver.py)."""
+    m, k, mb = 64, 8, 8
+    a = tu.random_hermitian_pd(m, dtype, seed=3)
+    b = tu.random_matrix(m, k, dtype, seed=4)
+    expected = np.linalg.solve(a.astype(np.complex128 if np.iscomplexobj(a) else np.float64),
+                               b.astype(np.complex128 if np.iscomplexobj(b) else np.float64))
+    tune.get_tune_parameters().update(gemm_precision="bf16x3")
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    x = positive_definite_solver("L", mat_a, mat_b, refine_to="input")
+    tu.assert_near(x, expected.astype(dtype), tu.tol_for(dtype, m, 500.0))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64], ids=str)
+def test_trsm_bf16x3_refined_meets_seed_bounds(grid_2x4, dtype):
+    m, k, mb = 64, 8, 8
+    a = tu.random_triangular(m, dtype, lower=True, seed=5)
+    b = tu.random_matrix(m, k, dtype, seed=6)
+    tune.get_tune_parameters().update(gemm_precision="bf16x3")
+    mat_a = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    x = triangular_solver("Left", "L", "N", "N", 1.0, mat_a, mat_b,
+                          refine_to="input")
+    xh = x.to_global()
+    # normwise backward error at the input dtype's rounding level
+    rnorm = np.max(np.abs(b - a @ xh))
+    bound = refine_tolerance(np.max(np.abs(a)), m, dtype) * max(np.max(np.abs(xh)), 1.0)
+    assert rnorm <= 50.0 * bound
+
+
+@pytest.mark.parametrize("dtype", [np.float64], ids=str)
+def test_posv_refine_noop_at_default_tier(grid_2x4, dtype):
+    """refine_to='input' at the default tier converges immediately and
+    stays within the seed bound (no degradation from the refined path)."""
+    m, k, mb = 32, 4, 8
+    a = tu.random_hermitian_pd(m, dtype, seed=8)
+    b = tu.random_matrix(m, k, dtype, seed=9)
+    mat_a = DistributedMatrix.from_global(grid_2x4, np.tril(a), (mb, mb))
+    mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+    x = positive_definite_solver("L", mat_a, mat_b, refine_to="input")
+    tu.assert_near(x, np.linalg.solve(a, b), tu.tol_for(dtype, m, 500.0))
+
+
+def test_residual_refine_bails_on_nan(grid_2x4):
+    """A poisoned iterate must not keep sweeping (corrections cannot
+    recover a NaN solve)."""
+    b = tu.random_matrix(16, 4, np.float32, seed=1)
+    x = DistributedMatrix.from_global(grid_2x4, b, (4, 4))
+    calls = []
+
+    def residual(xc):
+        calls.append(1)
+        return xc.like(xc.data * np.float32(np.nan))
+
+    x2, info = residual_refine(
+        x, residual, lambda r: r, tol=1e-7, anorm=1.0, max_sweeps=3)
+    assert len(calls) == 1 and not info.converged
+
+
+# --------------------------------------------------------- cache discipline
+
+
+def test_gemm_precision_flips_compiled_cache_keys(grid_2x4):
+    """Flipping the knob must trace fresh executables: the compiled-kernel
+    caches key on gemm_precision_trace_key(), never silently reusing a
+    kernel traced at another tier (DLAF001's contract)."""
+    m, mb = 32, 8
+    a = tu.random_matrix(m, m, np.float32, seed=31)
+    b = tu.random_matrix(m, m, np.float32, seed=32)
+
+    def run():
+        mat_a = DistributedMatrix.from_global(grid_2x4, a, (mb, mb))
+        mat_b = DistributedMatrix.from_global(grid_2x4, b, (mb, mb))
+        mat_c = DistributedMatrix.from_global(grid_2x4, np.zeros((m, m), np.float32), (mb, mb))
+        mul.general_multiplication("N", "N", 1.0, mat_a, mat_b, 0.0, mat_c)
+
+    tune.get_tune_parameters().update(gemm_precision="default")
+    run()
+    keys_default = set(mul._cache) | set(mul._local_cache)
+    assert any("default" in k for k in keys_default)
+    tune.get_tune_parameters().update(gemm_precision="bf16x3")
+    run()
+    keys_after = set(mul._cache) | set(mul._local_cache)
+    new = keys_after - keys_default
+    assert new and all("bf16x3" in k for k in new)
