@@ -61,6 +61,16 @@ TIME_FUNCS = frozenset({
     "monotonic_ns", "perf_counter_ns", "time_ns",
 })
 
+#: obs.spans emitters — host-side wall-clock instrumentation that must stay
+#: in orchestration code: inside a traced region each runs ONCE at trace
+#: time with garbage timing and leaks contextvar state into the trace.
+SPAN_EMITTERS = frozenset({
+    "span", "start_request", "finish_request", "mark_phase", "emit_span",
+})
+
+#: obs.flight recorder entry points — same constraint as spans.
+FLIGHT_EMITTERS = frozenset({"record", "dump", "auto_dump"})
+
 
 def _last(name: str | None) -> str:
     return name.rsplit(".", 1)[-1] if name else ""
@@ -301,6 +311,14 @@ def _scan_body(project, file, node, symbol, *, seed_params):
                 (head == "random" and name and name.count(".") == 1):
             flag(sub, f"host RNG '{name}()' inside traced code — one draw at "
                       f"trace time, constant forever; use jax.random")
+        elif last in SPAN_EMITTERS and head in ("spans", "ospans", "obs", "_spans"):
+            flag(sub, f"span emitter '{name}()' inside traced code — spans are "
+                      f"host-side orchestration markers (one garbage-timed emit "
+                      f"at trace time); move it outside the jit/shard_map")
+        elif last in FLIGHT_EMITTERS and head in ("flight", "oflight"):
+            flag(sub, f"flight-recorder call '{name}()' inside traced code — "
+                      f"the ring/dump is host state; hook failures in the "
+                      f"orchestration layer, not the traced body")
         elif last in ("float", "bool") and isinstance(sub.func, ast.Name) \
                 and sub.args and isinstance(sub.args[0], ast.Name) \
                 and sub.args[0].id in seed_params:

@@ -15,21 +15,32 @@ three independent, individually opt-in pieces:
                    config, stage wall-times, comms volumes, compile
                    durations, persistent-cache hits — rank-aware with a
                    rank-0 merge on multi-process worlds.
+  ``obs.spans``    request-scoped span tracing — contextvar-propagated
+                   trace/span/parent ids over host orchestration code
+                   (gateway admission through driver phases), emitted as
+                   ``span`` records on the metrics stream.
+  ``obs.flight``   crash flight recorder — bounded in-memory ring of the
+                   last N span/serve/health events (live even with JSONL
+                   off) dumped atomically on deadline/watchdog/dispatch
+                   failures, plus a device-memory watermark sampler.
+  ``obs.export``   ``python -m dlaf_tpu.obs.export`` — merged multi-rank
+                   span records to Chrome-trace/Perfetto JSON.
 
 Everything is OFF by default and the off path is free: ``comms.record`` and
-``metrics.emit`` return immediately on a ``None`` module global, and the
-in-kernel ``named_scope`` names only annotate op metadata (they change no
-computation — asserted by tests/test_obs.py HLO-equality test).
+``metrics.emit`` return immediately on ``None`` module globals, ``spans.span``
+returns a shared no-op after one flag test, and the in-kernel ``named_scope``
+names only annotate op metadata (they change no computation — asserted by
+tests/test_obs.py HLO-equality test).
 """
 from __future__ import annotations
 
 import contextlib
 
 from dlaf_tpu.common import stagetimer as _st
-from dlaf_tpu.obs import comms, metrics, trace
+from dlaf_tpu.obs import comms, flight, metrics, spans, trace
 from dlaf_tpu.obs.trace import phase, scope
 
-__all__ = ["comms", "metrics", "trace", "phase", "scope", "stage"]
+__all__ = ["comms", "flight", "metrics", "spans", "trace", "phase", "scope", "stage"]
 
 
 @contextlib.contextmanager

@@ -1,0 +1,163 @@
+"""Span records → Chrome-trace/Perfetto JSON.
+
+``python -m dlaf_tpu.obs.export run.jsonl [more.jsonl ...] -o trace.json``
+converts merged multi-rank metrics streams into the Trace Event Format
+that chrome://tracing and https://ui.perfetto.dev load directly:
+
+* each RANK becomes a process row (``pid`` = rank, named ``rank N``);
+* within a rank, spans group into per-TENANT tracks (``tid``) — a span
+  carrying a ``tenant`` attr pins its whole trace to that tenant's track,
+  everything else lands on the ``internal`` track — so a multi-tenant
+  gateway run reads as one lane per tenant per rank;
+* spans are complete events (``ph:"X"``) with trace/span/parent ids and
+  all attrs preserved under ``args`` (Perfetto's flow/args panes);
+* ``comms`` accounting rows become counter events (``ph:"C"``) showing
+  cumulative exposed vs overlapped modeled wire bytes per rank;
+* ``health`` records become instant events (``ph:"i"``) so failures line
+  up against the request timeline.
+
+Timestamps are microseconds relative to the earliest span start, so the
+viewer opens at t=0 instead of the unix epoch.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dlaf_tpu.obs import metrics as om
+
+
+def _tenant_of_trace(spans: list) -> dict:
+    """trace_id -> tenant for every trace whose any span carries a tenant
+    attr (the gateway stamps it on the root): child spans without the attr
+    still land on the tenant's track."""
+    out = {}
+    for rec in spans:
+        t = rec.get("tenant")
+        if t is not None:
+            out.setdefault(rec["trace_id"], str(t))
+    return out
+
+
+def to_chrome_trace(records: list) -> dict:
+    """Build the Trace Event Format document from parsed metrics records
+    (any mix of kinds: non-span kinds contribute counters/instants only)."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    tenants = _tenant_of_trace(spans)
+    base_s = min((r["t0_s"] for r in spans), default=0.0)
+
+    events = []
+    tids: dict = {}  # (pid, track-name) -> tid
+    seen_pids: dict = {}  # pid -> set of track names (for metadata emission)
+
+    def _tid(pid: int, track: str) -> int:
+        key = (pid, track)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == pid]) + 1
+            seen_pids.setdefault(pid, []).append(track)
+        return tids[key]
+
+    for rec in spans:
+        pid = int(rec.get("rank", 0))
+        track = tenants.get(rec["trace_id"], "internal")
+        args = {
+            k: v
+            for k, v in rec.items()
+            if k not in ("schema", "kind", "ts", "rank", "name", "t0_s", "dur_s")
+        }
+        events.append(
+            {
+                "ph": "X",
+                "pid": pid,
+                "tid": _tid(pid, f"tenant:{track}"),
+                "ts": (rec["t0_s"] - base_s) * 1e6,
+                "dur": rec["dur_s"] * 1e6,
+                "name": rec["name"],
+                "cat": "span",
+                "args": args,
+            }
+        )
+
+    for rec in records:
+        kind = rec.get("kind")
+        pid = int(rec.get("rank", 0))
+        if kind == "comms":
+            # Cumulative modeled wire bytes per rank: exposed vs overlapped
+            # (the overlap window accounting from obs.comms).
+            exposed = overlapped = 0.0
+            for row in rec.get("rows", []):
+                wire = float(row.get("wire_bytes", row.get("bytes", 0)) or 0)
+                over = float(row.get("overlapped_wire_bytes", 0) or 0)
+                overlapped += over
+                exposed += max(wire - over, 0.0)
+            events.append(
+                {
+                    "ph": "C",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": (rec.get("ts", base_s) - base_s) * 1e6,
+                    "name": "modeled wire bytes",
+                    "args": {"exposed": exposed, "overlapped": overlapped},
+                }
+            )
+        elif kind == "health":
+            events.append(
+                {
+                    "ph": "i",
+                    "pid": pid,
+                    "tid": _tid(pid, "tenant:internal"),
+                    "ts": (rec.get("ts", base_s) - base_s) * 1e6,
+                    "name": f"health:{rec.get('event')}",
+                    "s": "p",
+                    "args": {k: v for k, v in rec.items() if k not in ("schema", "kind")},
+                }
+            )
+
+    meta = []
+    for pid, tracks in sorted(seen_pids.items()):
+        meta.append(
+            {"ph": "M", "pid": pid, "name": "process_name", "args": {"name": f"rank {pid}"}}
+        )
+        meta.append({"ph": "M", "pid": pid, "name": "process_sort_index", "args": {"sort_index": pid}})
+        for track in tracks:
+            meta.append(
+                {
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tids[(pid, track)],
+                    "name": "thread_name",
+                    "args": {"name": track},
+                }
+            )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlaf_tpu.obs.export",
+        description="Convert dlaf_tpu metrics JSONL (with span records) to "
+        "Chrome-trace/Perfetto JSON.",
+    )
+    ap.add_argument("inputs", nargs="+", help="metrics JSONL file(s), already rank-merged or per-rank parts")
+    ap.add_argument("-o", "--out", required=True, help="output trace JSON path")
+    args = ap.parse_args(argv)
+
+    records = []
+    for path in args.inputs:
+        records.extend(om.read_jsonl(path))
+    doc = to_chrome_trace(records)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+        fh.write("\n")
+    n_spans = sum(1 for r in records if r.get("kind") == "span")
+    ranks = sorted({int(r.get("rank", 0)) for r in records if r.get("kind") == "span"})
+    print(
+        f"wrote {args.out}: {len(doc['traceEvents'])} events "
+        f"({n_spans} spans, ranks {ranks}) — load in chrome://tracing or ui.perfetto.dev"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Crash flight recorder: bounded in-memory ring of recent obs events.
+
+Every BENCH round to date died on ``DeviceUnresponsiveError`` with zero
+postmortem state (ROADMAP standing caveat) — by the time the watchdog
+fires, the JSONL metrics stream (if one was even enabled) shows aggregate
+history, not "what was the stack doing in the last two seconds".  This
+module keeps the answer in memory at all times:
+
+* :func:`enable` installs a tee on ``obs.metrics`` so every span/serve/
+  health/note record ALSO lands in a bounded ring (deque) — including
+  when no JSONL emitter is active, which is exactly the crash-on-TPU
+  configuration that has burned us;
+* :func:`dump` writes the ring atomically (tmp + ``os.replace``) to a
+  timestamped JSON file, together with the set of spans still OPEN at
+  crash time (``spans.open_spans()`` — the in-flight requests);
+* :func:`auto_dump` is the rate-limited hook the failure paths call
+  (``resilience.run_with_deadline`` deadline expiry, the watchdog's
+  ``DeviceUnresponsiveError``, unhandled gateway dispatch errors) —
+  it never raises: a broken disk must not mask the original error;
+* :func:`start_memory_sampler` optionally records periodic
+  ``device.memory_stats()`` watermarks into the ring so an OOM-adjacent
+  hang shows the allocation ramp.
+
+The ring costs one deque append per observed record while enabled and
+nothing at all when disabled (the metrics tee is unset).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+from dlaf_tpu.obs import metrics as om
+
+SCHEMA = "dlaf_tpu.flight/1"
+
+#: record kinds mirrored from the metrics stream into the ring.
+_TEE_KINDS = frozenset({"span", "serve", "health", "note"})
+
+_lock = threading.Lock()
+_ring: collections.deque | None = None
+_dump_dir: str | None = None
+# Dumps are rate-limited per reason family so a cascade (every request in
+# a dead batch raising DeadlineExceededError) leaves one dump, not 500.
+_min_dump_interval_s = 1.0
+_last_dump: dict = {}
+_sampler = None
+
+
+def enable(capacity: int = 1024, dump_dir: str | None = None) -> None:
+    """Start recording the last ``capacity`` events; dumps land in
+    ``dump_dir`` (default: current directory)."""
+    global _ring, _dump_dir
+    with _lock:
+        _ring = collections.deque(maxlen=int(capacity))
+        _dump_dir = dump_dir
+        _last_dump.clear()
+    om.set_tee(_tee)
+
+
+def disable() -> None:
+    global _ring, _dump_dir
+    stop_memory_sampler()
+    om.set_tee(None)
+    with _lock:
+        _ring = None
+        _dump_dir = None
+        _last_dump.clear()
+
+
+def enabled() -> bool:
+    return _ring is not None
+
+
+def _tee(kind: str, fields: dict) -> None:
+    """Metrics-stream tap (see ``metrics.set_tee``): mirror the interesting
+    kinds into the ring.  Runs on whatever thread emitted — lock held only
+    for the append."""
+    if kind not in _TEE_KINDS:
+        return
+    ring = _ring
+    if ring is None:
+        return
+    rec = {"kind": kind, "ts": time.time()}
+    rec.update(fields)
+    with _lock:
+        ring.append(rec)
+
+
+def record(kind: str, **fields) -> None:
+    """Append one event directly to the ring (watchdog probes, memory
+    watermarks — things that are not metrics records)."""
+    ring = _ring
+    if ring is None:
+        return
+    rec = {"kind": kind, "ts": time.time()}
+    rec.update(fields)
+    with _lock:
+        ring.append(rec)
+
+
+def snapshot() -> list:
+    """The ring contents, oldest first (empty when disabled)."""
+    with _lock:
+        return list(_ring) if _ring is not None else []
+
+
+def _rank() -> int:
+    """Best-effort process rank WITHOUT importing jax: the dump path runs
+    while the backend may be wedged."""
+    em = om.get()
+    if em is not None:
+        return em.rank
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+    return 0
+
+
+def dump(reason: str = "manual", path: str | None = None) -> str:
+    """Write the ring + open spans to a timestamped JSON file atomically;
+    returns the path written."""
+    from dlaf_tpu.obs import spans
+
+    with _lock:
+        events = list(_ring) if _ring is not None else []
+        dump_dir = _dump_dir
+    doc = {
+        "schema": SCHEMA,
+        "reason": reason,
+        "ts": time.time(),
+        "rank": _rank(),
+        "open_spans": spans.open_spans(),
+        "events": events,
+    }
+    if path is None:
+        now = time.time()
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
+        frac = int((now % 1) * 1000)
+        safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)[:48]
+        path = os.path.join(dump_dir or ".", f"flight_{stamp}-{frac:03d}_{safe}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, default=om._jsonable)
+        fh.write("\n")
+    os.replace(tmp, path)
+    # "flight" is not in _TEE_KINDS, so this cannot re-enter the ring.
+    om.emit("flight", reason=reason, path=path, events=len(events))
+    return path
+
+
+def auto_dump(reason: str) -> str | None:
+    """Failure-path hook: dump if enabled, rate-limited per reason family,
+    swallowing every error (the caller is already raising the real one)."""
+    if _ring is None:
+        return None
+    family = reason.split(":", 1)[0]
+    now = time.monotonic()
+    with _lock:
+        last = _last_dump.get(family)
+        if last is not None and now - last < _min_dump_interval_s:
+            return None
+        _last_dump[family] = now
+    try:
+        return dump(reason)
+    except Exception:
+        return None
+
+
+# ------------------------------------------------- memory watermark sampler
+
+
+class _MemorySampler(threading.Thread):
+    def __init__(self, interval_s: float, device):
+        super().__init__(name="dlaf-flight-mem", daemon=True)
+        self.interval_s = interval_s
+        self.device = device
+        # NB: not named _stop — Thread.join() calls a private _stop() method
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.interval_s):
+            try:
+                stats = self.device.memory_stats()
+            except Exception:
+                continue  # backend without memory_stats (CPU): keep trying
+            if stats:
+                record(
+                    "memory",
+                    device=str(self.device),
+                    bytes_in_use=stats.get("bytes_in_use"),
+                    peak_bytes_in_use=stats.get("peak_bytes_in_use"),
+                    bytes_limit=stats.get("bytes_limit"),
+                )
+
+    def stop(self) -> None:
+        self._halt.set()
+
+
+def start_memory_sampler(interval_s: float = 1.0, device=None) -> None:
+    """Record periodic device-memory watermarks into the ring (daemon
+    thread; no-op replace if one is already running)."""
+    global _sampler
+    stop_memory_sampler()
+    if device is None:
+        import jax
+
+        device = jax.local_devices()[0]
+    _sampler = _MemorySampler(float(interval_s), device)
+    _sampler.start()
+
+
+def stop_memory_sampler() -> None:
+    global _sampler
+    s, _sampler = _sampler, None
+    if s is not None:
+        s.stop()
+        s.join(timeout=5.0)

@@ -14,18 +14,28 @@ fully on a shared filesystem or a single-host multi-process world (the
 test harness); on disjoint hosts the per-rank parts simply stay put next
 to each host's working directory.
 
-Off by default and free when off: :func:`emit` is one ``is None`` test.
-Compile-time records ride ``jax.monitoring`` listeners that are registered
-once on first :func:`enable` and forward only while an emitter is active.
+Off by default and free when off: :func:`emit` is one ``is None`` test
+per sink (emitter + flight-recorder tee).  Compile-time records ride
+``jax.monitoring`` listeners that are registered once on first
+:func:`enable` and forward only while an emitter is active.
+
+Schema history: ``/1`` is the original record set; ``/2`` adds the
+``span`` (request-scoped tracing, ``obs.spans``) and ``flight`` (crash
+dump pointers, ``obs.flight``) kinds.  Writers stamp ``/2``; readers
+(:func:`validate_record`, :func:`read_jsonl`) accept both so old BENCH
+and metrics artifacts keep parsing.
 """
 from __future__ import annotations
 
 import json
 import os
 import sys
+import threading
 import time
 
-SCHEMA = "dlaf_tpu.obs/1"
+SCHEMA = "dlaf_tpu.obs/2"
+#: every schema tag a reader accepts (old artifacts carry /1).
+SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2")
 
 #: kind -> payload fields every record of that kind must carry.
 REQUIRED_FIELDS: dict = {
@@ -41,10 +51,18 @@ REQUIRED_FIELDS: dict = {
     "note": ("text",),
     "health": ("event",),
     "serve": ("event",),
+    # /2 additions:
+    "span": ("name", "trace_id", "span_id", "t0_s", "dur_s"),
+    "flight": ("reason", "path", "events"),
 }
 
 _emitter = None
 _listeners_registered = False
+# Optional secondary sink (the flight recorder's ring tap): called as
+# _tee(kind, fields) for every record emitted, whether or not a JSONL
+# emitter is active.  None = off (the common case; emit() stays two
+# module-global tests on the off path).
+_tee = None
 
 
 class MetricsEmitter:
@@ -58,19 +76,28 @@ class MetricsEmitter:
         self.nprocs = jax.process_count()
         self.path = path if self.rank == 0 else f"{path}.rank{self.rank}"
         self._fh = open(self.path, "w")
+        # The gateway dispatcher thread, pool workers/done-callbacks and
+        # jax.monitoring listeners all emit concurrently; an unlocked
+        # write+flush pair can interleave half-lines into the JSONL.
+        self._lock = threading.Lock()
 
     def emit(self, kind: str, **fields) -> None:
         rec = {"schema": SCHEMA, "kind": kind, "ts": time.time(), "rank": self.rank}
         rec.update(fields)
-        self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
-        self._fh.flush()
+        line = json.dumps(rec, default=_jsonable) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return  # closed concurrently: drop rather than raise
+            self._fh.write(line)
+            self._fh.flush()
 
     def close(self) -> None:
         """Flush, world-sync, and merge rank part files into ``base_path``."""
-        if self._fh is None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is None:
             return
-        self._fh.close()
-        self._fh = None
+        fh.close()
         if self.nprocs > 1:
             try:
                 from jax.experimental import multihost_utils
@@ -116,10 +143,24 @@ def get() -> MetricsEmitter | None:
 
 
 def emit(kind: str, **fields) -> None:
-    """Emit one record on the active stream; no-op when metrics are off."""
-    if _emitter is None:
-        return
-    _emitter.emit(kind, **fields)
+    """Emit one record on the active sinks (JSONL stream and/or flight
+    tee); no-op when both are off."""
+    if _emitter is not None:
+        _emitter.emit(kind, **fields)
+    if _tee is not None:
+        _tee(kind, fields)
+
+
+def set_tee(fn) -> None:
+    """Install (or clear, with None) the secondary record sink — the
+    flight recorder's ring tap.  One slot: last caller wins."""
+    global _tee
+    _tee = fn
+
+
+def sinking() -> bool:
+    """True when at least one sink would receive an emitted record."""
+    return _emitter is not None or _tee is not None
 
 
 def close() -> None:
@@ -232,8 +273,8 @@ def validate_record(rec: dict) -> None:
     """Raise ValueError unless ``rec`` is a schema-valid metrics record."""
     if not isinstance(rec, dict):
         raise ValueError(f"record is not an object: {type(rec).__name__}")
-    if rec.get("schema") != SCHEMA:
-        raise ValueError(f"bad schema tag: {rec.get('schema')!r} != {SCHEMA!r}")
+    if rec.get("schema") not in SCHEMAS:
+        raise ValueError(f"bad schema tag: {rec.get('schema')!r} not in {SCHEMAS}")
     kind = rec.get("kind")
     if kind not in REQUIRED_FIELDS:
         raise ValueError(f"unknown record kind: {kind!r}")

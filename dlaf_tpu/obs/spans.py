@@ -1,0 +1,288 @@
+"""Request-scoped span tracing: trace_id/span_id/parent_id over contextvars.
+
+The metrics layer (``obs.metrics``) answers "how much, in aggregate"; this
+module answers "where did THIS request's 200 ms go".  A span is a named,
+wall-clock-bounded interval emitted as a ``"span"`` metrics record
+(schema ``dlaf_tpu.obs/2``) carrying three identity fields:
+
+``trace_id``   shared by every span of one logical request,
+``span_id``    this interval,
+``parent_id``  the enclosing interval (absent on roots).
+
+Propagation is a single :mod:`contextvars` ContextVar holding
+``(trace_id, span_id)`` — contextvars follow asyncio tasks natively, so
+gateway coroutines nest for free, and the thread hops in this codebase
+(gateway dispatcher thread, ``SolverPool`` workers, pool done-callbacks,
+``resilience.run_with_deadline`` worker threads) are covered two ways:
+
+* explicit handles — requests carry their root handle on the request
+  object (``req.trace``) so whichever thread touches the request next can
+  stamp phase boundaries with :func:`mark_phase`;
+* ambient rebind — :func:`bind` installs a ``(trace_id, parent_id)``
+  context on the current thread so nested :func:`span`/``trace.phase``
+  calls attach to it, and ``run_with_deadline`` copies the caller's
+  context onto its worker thread.
+
+Spans are strictly HOST-side orchestration markers: never call any of
+this inside a ``jit``/``shard_map`` region (a traced call would emit once
+at trace time with garbage timing, or leak host state into the program).
+The analysis linter (DLAF003, ``analysis/rules/purity.py``) enforces this.
+
+Off path: with spans disabled, :func:`span` returns a shared no-op
+context manager after one module-global ``if`` and :func:`start_request`
+returns ``None`` — zero records, zero allocation on the hot path.
+Enabling spans requires an active sink (a ``metrics.enable`` stream or
+the flight-recorder tee) for the records to land anywhere.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+
+from dlaf_tpu.obs import metrics as om
+
+# (trace_id, span_id) of the innermost open span on this task/thread.
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "dlaf_tpu_span_ctx", default=None
+)
+
+_on = False
+_lock = threading.Lock()
+# span_id -> {name, trace_id, parent_id, t0_s} for every span currently
+# open anywhere in the process; the flight recorder dumps this on crash so
+# a postmortem shows the in-flight requests, not just completed intervals.
+_open: dict = {}
+
+
+def enable() -> None:
+    """Turn span emission on (records land on the active metrics/flight
+    sinks; with no sink enabled spans stay no-ops)."""
+    global _on
+    _on = True
+
+
+def disable() -> None:
+    global _on
+    _on = False
+    with _lock:
+        _open.clear()
+
+
+def enabled() -> bool:
+    return _on
+
+
+def active() -> bool:
+    """Spans are live only when enabled AND some sink will receive them."""
+    return _on and om.sinking()
+
+
+def new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current():
+    """The ambient ``(trace_id, span_id)`` pair, or None outside any span."""
+    return _ctx.get()
+
+
+def current_if_active():
+    """Like :func:`current` but cheap-gated on the enable flag: the single
+    branch callers on warm paths (``trace.phase``) pay when spans are off."""
+    if not _on:
+        return None
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def bind(ctx):
+    """Install ``(trace_id, parent_span_id)`` as the ambient context so
+    nested spans/phases attach under it.  ``bind(None)`` is a no-op pass-
+    through (callers thread an optional context without branching)."""
+    if ctx is None:
+        yield
+        return
+    tok = _ctx.set(tuple(ctx))
+    try:
+        yield
+    finally:
+        _ctx.reset(tok)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """Context manager for one live span interval (see :func:`span`)."""
+
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id", "_t0", "_m0", "_tok")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        parent = _ctx.get()
+        self.span_id = new_id()
+        if parent is not None:
+            self.trace_id, self.parent_id = parent[0], parent[1]
+        else:
+            self.trace_id, self.parent_id = new_id(), None
+        self._m0 = time.monotonic()
+        self._t0 = time.time()
+        self._tok = _ctx.set((self.trace_id, self.span_id))
+        _register_open(self.span_id, self.name, self.trace_id, self.parent_id, self._t0)
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.reset(self._tok)
+        _unregister_open(self.span_id)
+        emit_span(
+            self.name,
+            self.trace_id,
+            span_id=self.span_id,
+            parent_id=self.parent_id,
+            t0_s=self._t0,
+            dur_s=time.monotonic() - self._m0,
+            **self.attrs,
+        )
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager marking one named host-side interval.  Nested spans
+    (same task/thread, or across an explicit :func:`bind`) share the outer
+    trace_id and point their parent_id at the enclosing span."""
+    if not _on:
+        return _NOOP
+    return _Span(name, attrs)
+
+
+def emit_span(
+    name: str,
+    trace_id: str,
+    span_id: str | None = None,
+    parent_id: str | None = None,
+    *,
+    t0_s: float,
+    dur_s: float,
+    **attrs,
+) -> None:
+    """Emit one completed span record (used by the context manager and the
+    phase-boundary markers; callable directly for synthesized intervals)."""
+    if not active():
+        return
+    fields = dict(
+        name=name,
+        trace_id=trace_id,
+        span_id=span_id or new_id(),
+        t0_s=float(t0_s),
+        dur_s=float(dur_s),
+    )
+    if parent_id is not None:
+        fields["parent_id"] = parent_id
+    fields.update(attrs)
+    om.emit("span", **fields)
+
+
+def _register_open(span_id, name, trace_id, parent_id, t0_s) -> None:
+    with _lock:
+        _open[span_id] = {
+            "name": name,
+            "trace_id": trace_id,
+            "parent_id": parent_id,
+            "t0_s": t0_s,
+        }
+
+
+def _unregister_open(span_id) -> None:
+    with _lock:
+        _open.pop(span_id, None)
+
+
+def open_spans() -> list:
+    """Snapshot of every span currently open in the process (flight dumps
+    include this: the in-flight requests at crash time)."""
+    with _lock:
+        return [dict(v, span_id=k) for k, v in _open.items()]
+
+
+# ------------------------------------------------- request-handle API
+#
+# The gateway/pool path cannot use nested ``with`` blocks: one request's
+# lifetime crosses the asyncio submit call, the dispatcher thread, the pool
+# worker thread and a done-callback.  Instead the request carries a HANDLE
+# (plain dict) created at admission; each stage stamps a phase-boundary
+# child span covering [previous boundary, now) so the children tile the
+# root interval exactly — the per-request breakdown sums to the request
+# latency by construction.
+
+
+def start_request(name: str, t_submit_mono: float | None = None, **attrs):
+    """Open a root span for one request; returns the handle to thread
+    through the pipeline (None when spans are inactive — every downstream
+    marker no-ops on a None handle)."""
+    if not active():
+        return None
+    now_m = time.monotonic()
+    m0 = t_submit_mono if t_submit_mono is not None else now_m
+    t0_s = time.time() - (now_m - m0)
+    handle = {
+        "name": name,
+        "trace_id": new_id(),
+        "span_id": new_id(),
+        "parent_id": None,
+        "t0_s": t0_s,
+        "m0": m0,
+        "attrs": dict(attrs),
+    }
+    _register_open(handle["span_id"], name, handle["trace_id"], None, t0_s)
+    return handle
+
+
+def mark_phase(handle, name: str, t_prev_mono: float, *, span_id=None, **attrs) -> float:
+    """Emit a child span covering [t_prev_mono, now) under ``handle`` and
+    return the new boundary (monotonic now) for the next stage."""
+    now_m = time.monotonic()
+    if handle is not None:
+        emit_span(
+            name,
+            handle["trace_id"],
+            span_id=span_id,
+            parent_id=handle["span_id"],
+            t0_s=handle["t0_s"] + (t_prev_mono - handle["m0"]),
+            dur_s=now_m - t_prev_mono,
+            **attrs,
+        )
+    return now_m
+
+
+def finish_request(handle, **attrs) -> None:
+    """Close the root span opened by :func:`start_request` (no-op on None)."""
+    if handle is None:
+        return
+    _unregister_open(handle["span_id"])
+    merged = dict(handle["attrs"])
+    merged.update(attrs)
+    emit_span(
+        handle["name"],
+        handle["trace_id"],
+        span_id=handle["span_id"],
+        parent_id=None,
+        t0_s=handle["t0_s"],
+        dur_s=time.monotonic() - handle["m0"],
+        **merged,
+    )

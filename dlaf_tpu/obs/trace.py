@@ -32,6 +32,8 @@ import threading
 
 import jax
 
+from dlaf_tpu.obs import spans as _spans
+
 # Ordered log of phase names entered while a log is active (None = off).
 _phase_log: list | None = None
 _lock = threading.Lock()
@@ -65,10 +67,20 @@ def phase_log_active() -> bool:
 
 @contextlib.contextmanager
 def phase(name: str):
-    """Host-level named phase around orchestration code (see module doc)."""
+    """Host-level named phase around orchestration code (see module doc).
+
+    When request-scoped span tracing is live AND an ambient span context is
+    bound on this task/thread (``spans.bind``/an open ``spans.span``), the
+    phase additionally lands as a ``phase.<name>`` child span — this is how
+    driver phases (potrf panels, red2band sweeps) attach under the serve
+    request that triggered them.  Off path unchanged: one enable-flag test."""
     if _phase_log is not None:
         with _lock:
             if _phase_log is not None:
                 _phase_log.append(name)
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    if _spans.current_if_active() is not None:
+        with _spans.span(f"phase.{name}"), jax.profiler.TraceAnnotation(name):
+            yield
+    else:
+        with jax.profiler.TraceAnnotation(name):
+            yield

@@ -44,6 +44,7 @@ always the production code paths.
 """
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
@@ -123,6 +124,9 @@ def check_deadline(label: str | None = None) -> None:
     rem = remaining()
     if rem is not None and rem <= 0:
         health.record("deadline_exceeded", label=label, where="check")
+        from dlaf_tpu.obs import flight
+
+        flight.auto_dump(f"deadline_exceeded:{label or 'unlabeled'}")
         raise DeadlineExceededError(0.0, label=label)
 
 
@@ -145,10 +149,14 @@ def run_with_deadline(fn, *args, seconds: float | None = None,
         raise DeadlineExceededError(seconds, label=label)
     box: dict = {}
     done = threading.Event()
+    # the worker inherits the caller's contextvars (the ambient span
+    # context, for one) so host-side instrumentation inside fn nests
+    # under the request that dispatched it
+    ctx = contextvars.copy_context()
 
     def worker():
         try:
-            box["value"] = fn(*args, **kwargs)
+            box["value"] = ctx.run(fn, *args, **kwargs)
         except BaseException as exc:  # noqa: BLE001 - re-raised on the caller
             box["error"] = exc
         finally:
@@ -158,6 +166,12 @@ def run_with_deadline(fn, *args, seconds: float | None = None,
     th.start()
     if not done.wait(seconds):
         health.record("deadline_exceeded", label=label, budget_s=seconds)
+        from dlaf_tpu.obs import flight
+
+        # the watchdog's own probe classifies (and dumps) at its layer —
+        # dumping here too would burn the rate limit on the wrong reason
+        if not (label or "").startswith("watchdog"):
+            flight.auto_dump(f"deadline_exceeded:{label or 'unlabeled'}")
         raise DeadlineExceededError(seconds, label=label)
     if "error" in box:
         raise box["error"]
@@ -273,6 +287,9 @@ class DeviceWatchdog:
                 budget_s=budget,
                 device=str(self._device or "default"),
             )
+            from dlaf_tpu.obs import flight
+
+            flight.auto_dump("device_unresponsive")
             raise DeviceUnresponsiveError(
                 budget_s=budget, device=str(self._device or "default")
             ) from exc

@@ -51,7 +51,9 @@ from dlaf_tpu.health import (
     QueueFullError,
     TenantQuotaExceededError,
 )
+from dlaf_tpu.obs import flight as oflight
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
 from dlaf_tpu.serve import qos
 from dlaf_tpu.serve.pool import make_request
 from dlaf_tpu.serve.router import Replica, Router
@@ -185,6 +187,14 @@ class Gateway:
                 raise QueueFullError(self._queued_locked(), self.max_queue)
             c["admitted"] += 1
             self._pending[tenant] += 1
+            # span root opens at admission, anchored at t_submit so the
+            # validation cost is inside the request interval; set BEFORE
+            # the push — the done-callback (which closes the root) can
+            # fire the moment a dispatcher thread sees the request
+            req.trace = ospans.start_request(
+                "gw.request", t_submit_mono=req.t_submit, tenant=tenant, op=kind
+            )
+            req.t_mark = req.t_submit
             self._fq.push((req, cfg), cfg)
             self._cond.notify_all()
         req.future.add_done_callback(
@@ -300,6 +310,7 @@ class Gateway:
             else:
                 c["done_err"] += 1
             self._cond.notify_all()
+        ospans.finish_request(req.trace, outcome=outcome)
         om.emit("serve", event="gw_done", tenant=cfg.name, op=req.kind,
                 outcome=outcome, latency_s=lat)
 
@@ -322,7 +333,20 @@ class Gateway:
             # the condition would stall submitters, stats() and the
             # callbacks that drain _pending (the shipped livelock)
             for key, fb, live in ready:
-                self._dispatch(key, fb, live)
+                try:
+                    self._dispatch(key, fb, live)
+                except BaseException as exc:  # noqa: BLE001 - keep dispatching
+                    # an unhandled dispatch error would silently strand the
+                    # batch's futures AND kill the dispatcher thread: dump
+                    # the flight ring for the postmortem, surface the event,
+                    # and fail the futures (outside the lock) so callers see
+                    # the real exception
+                    oflight.auto_dump(f"gw_dispatch:{type(exc).__name__}")
+                    om.emit("serve", event="gw_dispatch_error",
+                            error=type(exc).__name__, batch=len(live))
+                    for req, _ in live:
+                        if not req.future.done():
+                            req.future.set_exception(exc)
 
     def _wait_timeout_locked(self, now: float):
         """Seconds until the dispatcher has work (0.0 = work is ready,
@@ -356,6 +380,8 @@ class Gateway:
             if req.expiry is not None and req.expiry <= now:
                 self._evict_locked(req, cfg, reason="deadline", where="queued")
                 continue
+            if req.trace is not None:
+                req.t_mark = ospans.mark_phase(req.trace, "gw.queue", req.t_mark)
             key = req.group_key()
             fb = self._forming.get(key)
             if fb is None:
@@ -388,6 +414,8 @@ class Gateway:
             if req.expiry is not None and req.expiry <= now:
                 self._evict_locked(req, cfg, reason="deadline", where="forming")
             else:
+                if req.trace is not None:
+                    req.t_mark = ospans.mark_phase(req.trace, "gw.batch", req.t_mark)
                 live.append((req, cfg))
         return (key, fb, live) if live else None
 
@@ -434,6 +462,14 @@ class Gateway:
                 om.emit("serve", event="gw_hold", reason="no_replica",
                         batch=len(live))
             return
+        # stamp the dispatch boundary BEFORE adopt: the pool worker can pop
+        # and mark pool.queue within microseconds of adoption, and the two
+        # marks must not race on t_mark
+        for req, _ in live:
+            if req.trace is not None:
+                req.t_mark = ospans.mark_phase(
+                    req.trace, "gw.dispatch", req.t_mark, replica=rep.name
+                )
         overflow = rep.pool.adopt([req for req, _ in live])
         adopted = len(live) - len(overflow)
         fill = adopted / self.max_batch

@@ -47,6 +47,7 @@ from dlaf_tpu.health import (
     QueueFullError,
 )
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
 from dlaf_tpu.serve import batched, bucketing
 
 KINDS = ("potrf", "posv", "eigh")
@@ -90,6 +91,12 @@ class _Request:
     future: Future
     t_submit: float
     expiry: float | None  # monotonic; None = unbounded
+    # span-tracing state (None/0.0 when spans are off or the request came
+    # straight to the pool): the root handle from spans.start_request plus
+    # the monotonic boundary of the last stamped phase — whichever thread
+    # touches the request next marks [t_mark, now) as the next child span.
+    trace: dict | None = None
+    t_mark: float = 0.0
 
     def group_key(self):
         k = self.b.shape[-1] if self.b is not None else None
@@ -331,6 +338,17 @@ class SolverPool:
     def _dispatch_locked(self, key, reqs) -> list:
         kind, uplo, bucket, _, _, _ = key
         t0 = time.monotonic()
+        # Phase boundary: everything since the last mark (gateway handoff,
+        # or pool queue wait + sibling _EXEC_LOCK contention) is pool.queue.
+        for r in reqs:
+            if r.trace is not None:
+                r.t_mark = ospans.mark_phase(r.trace, "pool.queue", r.t_mark)
+        # Driver phases (trace.phase inside cholesky/eigensolver) attach
+        # under ONE solve span per batch — the first traced member leads;
+        # nesting under its solve span (not the root) keeps every root's
+        # direct children tiling the request latency exactly once.
+        lead = next((r for r in reqs if r.trace is not None), None)
+        lead_solve_id = ospans.new_id() if lead is not None else None
         budgets = [r.remaining() for r in reqs if r.expiry is not None]
         seconds = min(budgets) if budgets else None
         cold = key not in self._warm
@@ -350,25 +368,28 @@ class SolverPool:
         else:
             a = np.stack([_pad_square(r.a, bucket) for r in reqs])
         try:
-            if kind == "potrf":
-                x, info = resilience.run_with_deadline(
-                    batched.batched_cholesky_factorization, uplo, a, self.grid,
-                    block_size=self.block_size, shard_batch=self.shard_batch,
-                    cache=self.cache, seconds=seconds, label=f"serve:{kind}",
-                )
-            elif kind == "posv":
-                b = np.stack([_pad_rows(r.b, bucket) for r in reqs])
-                x, info = resilience.run_with_deadline(
-                    batched.batched_positive_definite_solver, uplo, a, b,
-                    self.grid, block_size=self.block_size,
-                    shard_batch=self.shard_batch, cache=self.cache,
-                    seconds=seconds, label=f"serve:{kind}",
-                )
-            else:
-                w, v, info = resilience.run_with_deadline(
-                    batched.batched_eigensolver, uplo, a, self.grid,
-                    cache=self.cache, seconds=seconds, label=f"serve:{kind}",
-                )
+            with ospans.bind(
+                (lead.trace["trace_id"], lead_solve_id) if lead is not None else None
+            ):
+                if kind == "potrf":
+                    x, info = resilience.run_with_deadline(
+                        batched.batched_cholesky_factorization, uplo, a, self.grid,
+                        block_size=self.block_size, shard_batch=self.shard_batch,
+                        cache=self.cache, seconds=seconds, label=f"serve:{kind}",
+                    )
+                elif kind == "posv":
+                    b = np.stack([_pad_rows(r.b, bucket) for r in reqs])
+                    x, info = resilience.run_with_deadline(
+                        batched.batched_positive_definite_solver, uplo, a, b,
+                        self.grid, block_size=self.block_size,
+                        shard_batch=self.shard_batch, cache=self.cache,
+                        seconds=seconds, label=f"serve:{kind}",
+                    )
+                else:
+                    w, v, info = resilience.run_with_deadline(
+                        batched.batched_eigensolver, uplo, a, self.grid,
+                        cache=self.cache, seconds=seconds, label=f"serve:{kind}",
+                    )
         except BaseException as exc:  # noqa: BLE001 - routed to the futures
             return [(r, exc) for r in reqs]
         # warm only on success: a cold dispatch that dies before (or
@@ -393,5 +414,11 @@ class SolverPool:
                                   queue_s=queue_s, x=out.copy())
             om.emit("serve", event="request_done", op=kind, bucket=str(bucket),
                     queue_s=queue_s, info=int(info[i]))
+            if r.trace is not None:
+                r.t_mark = ospans.mark_phase(
+                    r.trace, "serve.solve", r.t_mark,
+                    span_id=lead_solve_id if r is lead else None,
+                    batch=len(reqs), bucket=str(bucket), cold=cold,
+                )
             done.append((r, res))
         return done

@@ -86,7 +86,8 @@ def summarize(path: str) -> int:
     if doc is not None:
         return _summarize_analysis(path, doc)
     recs = metrics.read_jsonl(path)
-    print(f"== {path}: {len(recs)} records ({metrics.SCHEMA})")
+    schemas = sorted({r.get("schema", "?") for r in recs}) or [metrics.SCHEMA]
+    print(f"== {path}: {len(recs)} records ({', '.join(schemas)})")
     by_kind = defaultdict(list)
     for r in recs:
         by_kind[r["kind"]].append(r)
@@ -378,6 +379,52 @@ def summarize(path: str) -> int:
             if fo:
                 print("   failover: "
                       + "  ".join(f"{e}={n}" for e, n in sorted(fo.items())))
+
+    span_recs = by_kind.get("span", [])
+    if span_recs:
+        def pctl(sorted_vals, q):
+            if not sorted_vals:
+                return float("nan")
+            return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+        by_name = defaultdict(list)
+        for r in span_recs:
+            by_name[r["name"]].append(float(r["dur_s"]))
+        print(f"-- spans ({len(span_recs)} spans, {len(by_name)} names):")
+        print(f"   {'name':28s} {'count':>7s} {'total s':>9s} "
+              f"{'p50 ms':>8s} {'p95 ms':>8s}")
+        for name, durs in sorted(by_name.items(), key=lambda kv: -sum(kv[1])):
+            ds = sorted(durs)
+            print(f"   {name:28s} {len(ds):7d} {sum(ds):9.3f} "
+                  f"{pctl(ds, 0.50) * 1e3:8.1f} {pctl(ds, 0.95) * 1e3:8.1f}")
+        # per-request breakdown: where the gateway requests' latency went —
+        # the direct children of each gw.request root tile its interval
+        # (queue -> batch -> dispatch -> pool queue -> solve)
+        roots = {r["span_id"]: r for r in span_recs if r["name"] == "gw.request"}
+        if roots:
+            phase_tot = defaultdict(float)
+            for r in span_recs:
+                if r.get("parent_id") in roots:
+                    phase_tot[r["name"]] += float(r["dur_s"])
+            total_lat = sum(float(r["dur_s"]) for r in roots.values())
+            print(f"   request breakdown ({len(roots)} requests, "
+                  f"{total_lat:.3f}s summed latency):")
+            for name, tot in sorted(phase_tot.items(), key=lambda kv: -kv[1]):
+                pct = f" {100 * tot / total_lat:5.1f}%" if total_lat else ""
+                print(f"      {name:24s} {tot:9.3f}s{pct}")
+            per_tenant = defaultdict(list)
+            for r in roots.values():
+                per_tenant[str(r.get("tenant", "?"))].append(float(r["dur_s"]))
+            print(f"   per-tenant critical path:")
+            print(f"   {'tenant':>12s} {'requests':>9s} {'p50 ms':>8s} {'p95 ms':>8s}")
+            for t, durs in sorted(per_tenant.items()):
+                ds = sorted(durs)
+                print(f"   {t:>12s} {len(ds):9d} "
+                      f"{pctl(ds, 0.50) * 1e3:8.1f} {pctl(ds, 0.95) * 1e3:8.1f}")
+
+    for r in by_kind.get("flight", []):
+        print(f"-- flight dump (rank {r['rank']}): {r['reason']} -> "
+              f"{r['path']} ({r['events']} events)")
 
     for r in by_kind.get("note", []):
         print(f"-- note (rank {r['rank']}): {r['text']}")

@@ -16,7 +16,13 @@ pools on the host CPU mesh — and checks the run's SLOs:
   unhandled errors;
 * the continuous batcher keeps the mean batch-fill ratio >= 0.5;
 * per-tenant p50/p95/p99 land in the ``--out`` JSONL (``gw_done`` +
-  ``gw_slo`` events) for ``scripts/report_metrics.py``.
+  ``gw_slo`` events) for ``scripts/report_metrics.py``;
+* with ``--trace-out trace.json``, request-scoped span tracing is enabled
+  for the run and the merged span records are exported as Chrome-trace/
+  Perfetto JSON (load in chrome://tracing or ui.perfetto.dev), with two
+  extra SLO checks: >= 95%% of completed requests carry the full span
+  chain (submit -> queue -> batch -> dispatch -> solve) and their summed
+  child durations land within 10%% of the recorded request latency.
 
 CI runs the 500-request flavour as the serve-loadgen lane; the 10k
 default is the acceptance run.  Exit is nonzero if any check fails.
@@ -25,9 +31,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 import sys
 import time
+from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -45,7 +53,9 @@ from dlaf_tpu.health import (
     QueueFullError,
     TenantQuotaExceededError,
 )
+from dlaf_tpu.obs import export as oexport
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
 from dlaf_tpu.testing import random_hermitian_pd, random_matrix
 
 
@@ -148,9 +158,14 @@ def main(argv=None) -> int:
                     help="max in-flight requests per tenant submitter")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="serve_loadgen.jsonl")
+    ap.add_argument("--trace-out", default=None,
+                    help="also enable span tracing and write the run's "
+                         "Chrome-trace/Perfetto JSON here")
     args = ap.parse_args(argv)
 
     om.enable(args.out)
+    if args.trace_out:
+        ospans.enable()
     om.emit_run_meta("serve_loadgen")
     tune.initialize(serve_buckets="16,32,48")
 
@@ -178,6 +193,7 @@ def main(argv=None) -> int:
     finally:
         router.close()
     elapsed = time.monotonic() - t0
+    ospans.disable()
     om.close()
 
     total = sum(counts.values())
@@ -212,6 +228,41 @@ def main(argv=None) -> int:
            "latency percentiles ordered per tenant")
     done = [r for r in recs if r["event"] == "gw_done"]
     expect(len(done) == total, f"gw_done per request in the stream ({len(done)})")
+
+    if args.trace_out:
+        allrecs = om.read_jsonl(args.out)
+        sp = [r for r in allrecs if r["kind"] == "span"]
+        doc = oexport.to_chrome_trace(allrecs)
+        with open(args.trace_out, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+        roots = [r for r in sp
+                 if r["name"] == "gw.request" and r.get("outcome") == "ok"]
+        kids = defaultdict(list)
+        for r in sp:
+            if r.get("parent_id") is not None:
+                kids[r["parent_id"]].append(r)
+        chain = {"gw.queue", "gw.batch", "gw.dispatch", "pool.queue", "serve.solve"}
+        full = tight = 0
+        for r in roots:
+            ch = kids.get(r["span_id"], [])
+            if chain <= {c["name"] for c in ch}:
+                full += 1
+            csum = sum(c["dur_s"] for c in ch)
+            if abs(csum - r["dur_s"]) <= 0.10 * max(r["dur_s"], 1e-9):
+                tight += 1
+        nr = len(roots)
+        n_ok = counts["ok"] + counts["solver_info"]
+        print(f"   trace: {len(sp)} spans, {nr} completed request roots "
+              f"-> {args.trace_out} ({len(doc['traceEvents'])} events)")
+        expect(nr == n_ok,
+               f"span root per completed request ({nr}/{n_ok})")
+        expect(nr > 0 and full >= 0.95 * nr,
+               f"full submit->queue->batch->dispatch->solve chain on >= 95% "
+               f"of completed requests ({full}/{nr})")
+        expect(nr > 0 and tight >= 0.95 * nr,
+               f"summed child durations within 10% of request latency on "
+               f">= 95% of completed requests ({tight}/{nr})")
 
     print(("PASS" if not failures else "FAIL")
           + f"  serve_loadgen ({len(recs)} serve events)")

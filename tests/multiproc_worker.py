@@ -325,6 +325,54 @@ def case_serve_batched(grid, args):
     assert cache.counters["miss"] == 2 and cache.counters["hit"] == 1
 
 
+def case_spans(grid, args):
+    """Multi-rank span merge: every rank emits request spans under ONE
+    shared trace id into the rank-aware metrics stream, ``close()``
+    world-syncs and rank 0 merges the part files, then rank 0 re-reads the
+    merged stream and runs the Perfetto exporter — every rank must land on
+    its own process row and the trace id must survive the merge."""
+    import os
+    import tempfile
+
+    from jax.experimental import multihost_utils
+
+    from dlaf_tpu.comm import multihost
+    from dlaf_tpu.obs import export as oexport
+    from dlaf_tpu.obs import metrics as om
+    from dlaf_tpu.obs import spans
+
+    rank = multihost.process_info()[0]
+    path = os.path.join(tempfile.gettempdir(), f"dlaf_mp_spans_{args.nprocs}.jsonl")
+    if rank == 0 and os.path.exists(path):
+        os.remove(path)
+    multihost_utils.sync_global_devices("multiproc_worker.case_spans.clean")
+    om.enable(path)
+    spans.enable()
+    trace_id = "mp-shared-trace-0123"
+    try:
+        with spans.bind((trace_id, None)):
+            with spans.span(f"rank{rank}.work", rank_attr=rank):
+                with spans.span("child"):
+                    pass
+    finally:
+        spans.disable()
+        om.close()  # world-sync, then rank 0 appends the rank part files
+    if rank == 0:
+        recs = om.read_jsonl(path)
+        sp = [r for r in recs if r["kind"] == "span"]
+        assert {r["rank"] for r in sp} == set(range(args.nprocs)), sp
+        assert {r["trace_id"] for r in sp} == {trace_id}, sp
+        doc = oexport.to_chrome_trace(recs)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {e["pid"] for e in xs} == set(range(args.nprocs)), xs
+        assert all(e["args"]["trace_id"] == trace_id for e in xs), xs
+        names = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        assert {m["pid"] for m in names} == set(range(args.nprocs)), names
+        os.remove(path)
+    multihost_utils.sync_global_devices("multiproc_worker.case_spans.done")
+
+
 CASES = {
     "roundtrip": case_roundtrip,
     "hdf5": case_hdf5,
@@ -336,6 +384,7 @@ CASES = {
     "heev_c128": case_heev_c128,
     "scalapack_local": case_scalapack_local,
     "serve_batched": case_serve_batched,
+    "spans": case_spans,
 }
 
 

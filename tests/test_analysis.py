@@ -277,6 +277,69 @@ def test_dlaf003_untraced_code_clean():
     assert purity.check(proj) == []
 
 
+def test_dlaf003_span_emitter_in_jitted_body():
+    """obs.spans calls are host-side orchestration markers: inside a traced
+    region they emit once at trace time with garbage timing (ISSUE 10)."""
+    proj = _project({"dlaf_tpu/ops/kern.py": """
+        import jax
+        from dlaf_tpu.obs import spans
+
+        def body(x):
+            with spans.span("tile"):
+                return x * 2
+
+        def run(x):
+            return jax.jit(body)(x)
+    """}, with_tune=False)
+    findings = purity.check(proj)
+    assert len(findings) == 1
+    assert findings[0].rule == "DLAF003" and findings[0].symbol == "body"
+    assert "span emitter 'spans.span()'" in findings[0].message
+
+
+def test_dlaf003_flight_recorder_in_shard_mapped_body():
+    proj = _project({"dlaf_tpu/ops/kern.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from dlaf_tpu.obs import flight as oflight
+
+        def tile(x):
+            oflight.record("probe", x=1)
+            return x + 1
+
+        def run(mesh, x):
+            return shard_map(tile, mesh=mesh, in_specs=None, out_specs=None)(x)
+    """}, with_tune=False)
+    findings = purity.check(proj)
+    assert len(findings) == 1
+    assert "flight-recorder call 'oflight.record()'" in findings[0].message
+
+
+def test_dlaf003_span_in_host_orchestration_clean():
+    """The supported pattern: spans/flight in plain host functions (even
+    ones that CALL jitted kernels) are not traced code — no finding."""
+    proj = _project({"dlaf_tpu/serve/orch.py": """
+        import jax
+        from dlaf_tpu.obs import flight as oflight
+        from dlaf_tpu.obs import spans
+
+        def kernel(x):
+            return x * 2
+
+        def dispatch(x):
+            with spans.span("dispatch"):
+                h = spans.start_request("req")
+                try:
+                    return jax.jit(kernel)(x)
+                except Exception:
+                    oflight.auto_dump("dispatch_error")
+                    raise
+                finally:
+                    spans.finish_request(h)
+    """}, with_tune=False)
+    assert purity.check(proj) == []
+
+
 # --------------------------------------------- DLAF004 serve lock discipline
 
 

@@ -76,6 +76,14 @@ def test_mp2_potrf_ckpt_resume():
     run_world(2, 4, "potrf_ckpt", n=32, nb=8)
 
 
+def test_mp2_spans():
+    """2 processes x 4 devices: both ranks emit spans under one shared
+    trace id, close() merges the rank parts, and the Perfetto exporter
+    assigns distinct process rows with the trace_id intact (ISSUE 10
+    multi-rank span-merge acceptance)."""
+    run_world(2, 4, "spans", n=24, nb=8)
+
+
 def test_mp2_serve_batched():
     """2 processes x 4 devices: serve batched potrf/posv with the BATCH
     axis sharded across processes — each rank's devices own a slice of the

@@ -1,0 +1,617 @@
+"""Request-scoped span tracing + crash flight recorder tests (ISSUE 10).
+
+The load-bearing invariants:
+
+- spans are OFF by default and free when off: ``span()`` returns one shared
+  no-op object after a single flag test, ``start_request`` returns None,
+  and a metrics stream with spans disabled gains ZERO span records;
+- when on, nested spans (same thread, across ``run_with_deadline`` worker
+  threads, across asyncio tasks) share a trace_id and chain parent ids,
+  and the gateway's phase-boundary marks tile each request's latency so
+  the per-request breakdown sums to the ``gw_done`` latency;
+- the ``/2`` schema is a strict extension: ``/1`` records still validate
+  and old files still parse;
+- ``MetricsEmitter.emit`` is thread-safe (the gateway dispatcher, pool
+  callbacks and jax.monitoring all write one handle);
+- the flight recorder captures span/serve/health events with JSONL
+  metrics OFF, dumps atomically on deadline/watchdog/dispatch failures
+  (rate-limited), and the dump carries the spans still open at crash time
+  — the ROADMAP's "BENCH died with zero postmortem state" fix.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.testing as tu
+from dlaf_tpu import resilience, serve, tune
+from dlaf_tpu.health import DeadlineExceededError, DeviceUnresponsiveError
+from dlaf_tpu.obs import export as oexport
+from dlaf_tpu.obs import flight as oflight
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import trace as otrace
+from dlaf_tpu.serve.qos import TenantConfig
+from dlaf_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _spans_clean():
+    """Never leak spans/flight/metrics state across tests."""
+    yield
+    oflight.disable()
+    ospans.disable()
+    om.close()
+    if otrace.phase_log_active():
+        otrace.stop_phase_log()
+
+
+def _spans_of(path):
+    return [r for r in om.read_jsonl(path) if r["kind"] == "span"]
+
+
+# ------------------------------------------------------------- off path
+
+
+def test_spans_off_is_free_and_emits_nothing(tmp_path):
+    # no sinks, spans disabled: the off path allocates nothing
+    assert not ospans.active()
+    assert ospans.span("a") is ospans.span("b")  # shared no-op singleton
+    assert ospans.start_request("r") is None
+    ospans.finish_request(None)  # all markers no-op on a None handle
+    assert ospans.mark_phase(None, "x", time.monotonic()) > 0
+    # metrics ON but spans OFF: phases and markers add ZERO span records
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    with otrace.phase("p1"):
+        pass
+    assert ospans.start_request("r") is None
+    om.emit("note", text="x")
+    om.close()
+    assert _spans_of(path) == []
+    # spans ENABLED but no sink: still inactive (nowhere for records to go)
+    ospans.enable()
+    assert not ospans.active()
+    assert ospans.start_request("r") is None
+
+
+def test_spans_leave_hlo_unchanged(tmp_path):
+    """Spans are host-side only: lowering a jitted kernel inside an active
+    span + phase produces byte-identical StableHLO."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda a: jnp.sum(a @ a))
+    x = np.ones((8, 8), np.float32)
+    txt_off = fn.lower(x).as_text()
+    om.enable(str(tmp_path / "m.jsonl"))
+    ospans.enable()
+    with ospans.span("outer"):
+        with otrace.phase("inner"):
+            txt_on = fn.lower(x).as_text()
+    assert txt_on == txt_off
+
+
+# ------------------------------------------------------------- span trees
+
+
+def test_nested_spans_share_trace_and_chain_parents(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    with ospans.span("root", tenant="t0"):
+        with ospans.span("mid"):
+            with ospans.span("leaf"):
+                pass
+    om.close()
+    by_name = {r["name"]: r for r in _spans_of(path)}
+    assert set(by_name) == {"root", "mid", "leaf"}
+    root, mid, leaf = by_name["root"], by_name["mid"], by_name["leaf"]
+    assert root["schema"] == "dlaf_tpu.obs/2"
+    assert "parent_id" not in root and root["tenant"] == "t0"
+    assert mid["parent_id"] == root["span_id"]
+    assert leaf["parent_id"] == mid["span_id"]
+    assert {r["trace_id"] for r in by_name.values()} == {root["trace_id"]}
+    # children nest inside the parent's interval
+    assert root["dur_s"] >= mid["dur_s"] >= leaf["dur_s"] >= 0
+
+
+def test_phase_attaches_as_child_span_only_when_ambient(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    with otrace.phase("orphan"):  # no ambient span: no record
+        pass
+    with ospans.span("driver"):
+        with otrace.phase("potrf"):
+            pass
+    om.close()
+    by_name = {r["name"]: r for r in _spans_of(path)}
+    assert set(by_name) == {"driver", "phase.potrf"}
+    assert by_name["phase.potrf"]["parent_id"] == by_name["driver"]["span_id"]
+
+
+def test_span_context_crosses_deadline_worker_thread(tmp_path):
+    """run_with_deadline copies the caller's context onto its worker, so
+    instrumentation inside the bounded fn nests under the caller's span."""
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+
+    def fn():
+        with ospans.span("inner"):
+            pass
+
+    with ospans.span("outer"):
+        resilience.run_with_deadline(fn, seconds=30.0)
+    om.close()
+    by_name = {r["name"]: r for r in _spans_of(path)}
+    assert by_name["inner"]["trace_id"] == by_name["outer"]["trace_id"]
+    assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+
+
+def test_span_context_isolated_across_asyncio_tasks(tmp_path):
+    import asyncio
+
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+
+    async def work(name):
+        with ospans.span(name):
+            await asyncio.sleep(0.01)
+            return ospans.current()
+
+    async def main():
+        return await asyncio.gather(work("a"), work("b"))
+
+    ctx_a, ctx_b = asyncio.run(main())
+    om.close()
+    assert ctx_a[0] != ctx_b[0]  # distinct traces: no cross-task nesting
+    roots = _spans_of(path)
+    assert {r["name"] for r in roots} == {"a", "b"}
+    assert all("parent_id" not in r for r in roots)
+
+
+def test_bind_installs_explicit_context(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    with ospans.bind(("sharedtrace0001", "parentspan00001")):
+        with ospans.span("child"):
+            pass
+    with ospans.bind(None):  # None: pass-through
+        pass
+    om.close()
+    (rec,) = _spans_of(path)
+    assert rec["trace_id"] == "sharedtrace0001"
+    assert rec["parent_id"] == "parentspan00001"
+
+
+def test_request_handle_marks_tile_the_interval(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    h = ospans.start_request("gw.request", tenant="t")
+    t = ospans.mark_phase(h, "queue", h["m0"])
+    time.sleep(0.02)
+    t = ospans.mark_phase(h, "solve", t)
+    ospans.finish_request(h, outcome="ok")
+    om.close()
+    recs = _spans_of(path)
+    root = next(r for r in recs if r["name"] == "gw.request")
+    kids = [r for r in recs if r.get("parent_id") == root["span_id"]]
+    assert {r["name"] for r in kids} == {"queue", "solve"}
+    ksum = sum(r["dur_s"] for r in kids)
+    assert abs(ksum - root["dur_s"]) <= 0.10 * root["dur_s"]
+    # wall-clock t0 chain: each child starts where the previous ended
+    kids.sort(key=lambda r: r["t0_s"])
+    assert abs(kids[0]["t0_s"] - root["t0_s"]) < 0.005
+    assert abs(kids[0]["t0_s"] + kids[0]["dur_s"] - kids[1]["t0_s"]) < 0.005
+
+
+# ------------------------------------------------------------- schema
+
+
+def test_schema_v1_and_v2_both_validate():
+    base = {"ts": time.time(), "rank": 0, "kind": "note", "text": "x"}
+    om.validate_record({"schema": "dlaf_tpu.obs/1", **base})
+    om.validate_record({"schema": "dlaf_tpu.obs/2", **base})
+    with pytest.raises(ValueError, match="bad schema tag"):
+        om.validate_record({"schema": "dlaf_tpu.obs/3", **base})
+    om.validate_record({
+        "schema": "dlaf_tpu.obs/2", "ts": 0.0, "rank": 0, "kind": "span",
+        "name": "x", "trace_id": "t", "span_id": "s", "t0_s": 0.0, "dur_s": 0.1,
+    })
+    with pytest.raises(ValueError, match="missing fields"):
+        om.validate_record({
+            "schema": "dlaf_tpu.obs/2", "ts": 0.0, "rank": 0, "kind": "span",
+            "name": "x",
+        })
+
+
+def test_read_jsonl_accepts_v1_files(tmp_path):
+    path = str(tmp_path / "old.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"schema": "dlaf_tpu.obs/1", "kind": "note",
+                             "ts": 1.0, "rank": 0, "text": "old artifact"}) + "\n")
+    (rec,) = om.read_jsonl(path)
+    assert rec["text"] == "old artifact"
+
+
+def test_emitter_stamps_v2(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    om.emit("note", text="x")
+    om.close()
+    (rec,) = om.read_jsonl(path)
+    assert rec["schema"] == "dlaf_tpu.obs/2"
+
+
+# ------------------------------------------------------- emit thread-safety
+
+
+def test_emit_thread_hammer_keeps_jsonl_parseable(tmp_path):
+    """Satellite: concurrent emits from many threads must not interleave
+    JSONL lines (the pre-fix emitter wrote handle+flush unlocked)."""
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    n_threads, n_each = 8, 200
+    start = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        start.wait()
+        for i in range(n_each):
+            om.emit("note", text=f"t{tid}.{i}", payload="x" * 64)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    om.close()
+    recs = om.read_jsonl(path)  # validates every record: a torn line fails
+    assert len(recs) == n_threads * n_each
+    texts = {r["text"] for r in recs}
+    assert len(texts) == n_threads * n_each  # nothing lost or duplicated
+
+
+def test_emit_concurrent_close_never_raises(tmp_path):
+    om.enable(str(tmp_path / "m.jsonl"))
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.wait(0.0):
+            om.emit("note", text="x")
+
+    th = threading.Thread(target=hammer)
+    th.start()
+    time.sleep(0.02)
+    om.close()  # racing emits drop silently instead of raising on a closed fh
+    stop.set()
+    th.join()
+
+
+# ------------------------------------------------------- gateway span chain
+
+
+def test_gateway_request_span_chain_end_to_end(tmp_path):
+    """The acceptance chain on a real gateway+pool: every completed request
+    carries submit -> gw.queue -> gw.batch -> gw.dispatch -> pool.queue ->
+    serve.solve children whose durations sum to the request latency."""
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    tune.initialize(serve_buckets="16")
+    try:
+        with serve.SolverPool(block_size=8, max_batch=4) as pool:
+            with serve.Gateway(pool, [TenantConfig("t")], max_batch=4,
+                               linger_ms=2.0) as gw:
+                futs = [gw.submit_nowait(
+                            "t", "potrf", "L",
+                            tu.random_hermitian_pd(16, np.float32, seed=70 + i))
+                        for i in range(6)]
+                for f in futs:
+                    assert f.result(timeout=300).info == 0
+    finally:
+        tune.initialize()
+    ospans.disable()
+    om.close()
+    recs = _spans_of(path)
+    roots = [r for r in recs if r["name"] == "gw.request"]
+    assert len(roots) == 6
+    chain = {"gw.queue", "gw.batch", "gw.dispatch", "pool.queue", "serve.solve"}
+    for root in roots:
+        assert root["tenant"] == "t" and root["op"] == "potrf"
+        assert root["outcome"] == "ok"
+        kids = [r for r in recs if r.get("parent_id") == root["span_id"]]
+        assert chain <= {k["name"] for k in kids}
+        ksum = sum(k["dur_s"] for k in kids)
+        assert abs(ksum - root["dur_s"]) <= 0.10 * root["dur_s"], (
+            ksum, root["dur_s"])
+    # no orphans: every child points at a span that exists in the stream
+    ids = {r["span_id"] for r in recs}
+    assert all(r["parent_id"] in ids for r in recs if "parent_id" in r)
+    # gw_done latency and the root span measure the same interval
+    done = [r for r in om.read_jsonl(path)
+            if r["kind"] == "serve" and r["event"] == "gw_done"]
+    assert len(done) == 6
+    for root in roots:
+        lat = min(abs(d["latency_s"] - root["dur_s"]) for d in done)
+        assert lat <= 0.05 * max(root["dur_s"], 1e-3)
+
+
+def test_gateway_with_spans_off_adds_no_records(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    tune.initialize(serve_buckets="16")
+    try:
+        with serve.SolverPool(block_size=8, max_batch=2) as pool:
+            with serve.Gateway(pool, [TenantConfig("t")], max_batch=2,
+                               linger_ms=1.0) as gw:
+                f = gw.submit_nowait(
+                    "t", "potrf", "L",
+                    tu.random_hermitian_pd(16, np.float32, seed=80))
+                assert f.result(timeout=300).info == 0
+    finally:
+        tune.initialize()
+    om.close()
+    assert _spans_of(path) == []
+
+
+def test_driver_phases_attach_under_bound_solve_span(tmp_path, grid_2x4):
+    """The pool's batch bind: driver phases (obs.stage -> trace.phase inside
+    cholesky_factorization) become children of the synthesized solve span
+    when the ambient context is bound around the driver call."""
+    from dlaf_tpu import cholesky_factorization
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    a = tu.random_hermitian_pd(16, np.float32, seed=60)
+    mat = DistributedMatrix.from_global(grid_2x4, a, (8, 8))
+    trace_id, solve_id = ospans.new_id(), ospans.new_id()
+    with ospans.bind((trace_id, solve_id)):
+        cholesky_factorization("L", mat)
+    om.close()
+    phases = [r for r in _spans_of(path) if r["name"].startswith("phase.")]
+    assert any(r["name"] == "phase.potrf" for r in phases)
+    assert all(r["trace_id"] == trace_id for r in phases)
+    potrf = next(r for r in phases if r["name"] == "phase.potrf")
+    assert potrf["parent_id"] == solve_id
+
+
+# ------------------------------------------------------------- flight ring
+
+
+def test_flight_ring_tees_metrics_and_bounds_capacity(tmp_path):
+    oflight.enable(capacity=4, dump_dir=str(tmp_path))
+    om.enable(str(tmp_path / "m.jsonl"))
+    for i in range(10):
+        om.emit("serve", event=f"e{i}")
+    om.emit("run", name="not-teed", seconds=0.0)  # kind not in the tee set
+    snap = oflight.snapshot()
+    assert [e["event"] for e in snap] == ["e6", "e7", "e8", "e9"]
+    assert all(e["kind"] == "serve" for e in snap)
+
+
+def test_flight_records_spans_with_metrics_off(tmp_path):
+    """The crash-on-TPU configuration: no JSONL stream, flight ring on —
+    spans still count as sinking and land in the ring."""
+    oflight.enable(capacity=64, dump_dir=str(tmp_path))
+    ospans.enable()
+    assert ospans.active()  # the tee alone is a sink
+    with ospans.span("work"):
+        pass
+    h = ospans.start_request("gw.request", tenant="t")
+    path = oflight.dump("manual_test")
+    ospans.finish_request(h)
+    doc = json.load(open(path))
+    assert doc["schema"] == "dlaf_tpu.flight/1"
+    assert doc["reason"] == "manual_test"
+    assert any(e["kind"] == "span" and e["name"] == "work" for e in doc["events"])
+    # the still-open request shows up as an in-flight span
+    assert any(s["name"] == "gw.request" for s in doc["open_spans"])
+    assert not os.path.exists(path + f".tmp.{os.getpid()}")  # atomic replace
+
+
+def test_flight_auto_dump_rate_limited(tmp_path):
+    oflight.enable(capacity=8, dump_dir=str(tmp_path))
+    oflight.record("probe", seconds=0.1)
+    p1 = oflight.auto_dump("deadline_exceeded:serve:potrf")
+    p2 = oflight.auto_dump("deadline_exceeded:serve:posv")  # same family
+    assert p1 is not None and p2 is None
+    p3 = oflight.auto_dump("device_unresponsive")  # different family
+    assert p3 is not None and p3 != p1
+    assert oflight.auto_dump("manual") and not oflight.auto_dump("manual")
+    # disabled: no dumps, no errors
+    oflight.disable()
+    assert oflight.auto_dump("deadline_exceeded:x") is None
+
+
+def test_deadline_expiry_leaves_flight_dump(tmp_path):
+    oflight.enable(capacity=32, dump_dir=str(tmp_path))
+    with pytest.raises(DeadlineExceededError):
+        resilience.run_with_deadline(time.sleep, 5.0, seconds=0.05,
+                                     label="unit:block")
+    dumps = [p for p in os.listdir(str(tmp_path)) if p.startswith("flight_")]
+    assert len(dumps) == 1 and "deadline_exceeded" in dumps[0]
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert doc["reason"] == "deadline_exceeded:unit:block"
+    # the deadline_exceeded health event itself reached the ring first
+    assert any(e["kind"] == "health" and e["event"] == "deadline_exceeded"
+               for e in doc["events"])
+
+
+def test_hang_fault_watchdog_flight_dump(tmp_path):
+    """ISSUE 10 acceptance: an injected hang under the watchdog leaves a
+    flight dump containing the last probe events and the in-flight request
+    spans — no hardware required."""
+    oflight.enable(capacity=64, dump_dir=str(tmp_path))
+    ospans.enable()
+    wd = resilience.DeviceWatchdog(budget_s=0.3)
+    wd.probe()  # pre-compile the probe kernel; records a device_probe event
+    h = ospans.start_request("gw.request", tenant="bench", op="potrf")
+    with faults.hang(10.0):
+        with pytest.raises(DeviceUnresponsiveError):
+            wd.probe()
+    ospans.finish_request(h, outcome="DeviceUnresponsiveError")
+    dumps = sorted(p for p in os.listdir(str(tmp_path)) if p.startswith("flight_"))
+    assert len(dumps) == 1 and "device_unresponsive" in dumps[0]
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    events = doc["events"]
+    # last probe events: the healthy probe and the failure classification
+    assert any(e["kind"] == "health" and e["event"] == "device_probe"
+               for e in events)
+    assert any(e["kind"] == "health" and e["event"] == "device_unresponsive"
+               for e in events)
+    # the in-flight request span is in the open set with its identity
+    (open_req,) = [s for s in doc["open_spans"] if s["name"] == "gw.request"]
+    assert open_req["trace_id"] and open_req["t0_s"] > 0
+
+
+def test_gateway_dispatch_error_fails_futures_and_dumps(tmp_path):
+    oflight.enable(capacity=32, dump_dir=str(tmp_path))
+    tune.initialize(serve_buckets="16")
+    try:
+        with serve.SolverPool(block_size=8, max_batch=2) as pool:
+            gw = serve.Gateway(pool, [TenantConfig("t")], max_batch=2,
+                               linger_ms=1.0)
+
+            def boom():
+                raise RuntimeError("router exploded")
+
+            gw.router.route = boom
+            f = gw.submit_nowait("t", "potrf", "L",
+                                 tu.random_hermitian_pd(16, np.float32, seed=90))
+            with pytest.raises(RuntimeError, match="router exploded"):
+                f.result(timeout=60)
+            # the dispatcher survived the error: close() still drains cleanly
+            gw.close()
+    finally:
+        tune.initialize()
+    dumps = [p for p in os.listdir(str(tmp_path)) if p.startswith("flight_")]
+    assert len(dumps) == 1 and "gw_dispatch" in dumps[0]
+
+
+def test_memory_sampler_records_watermarks():
+    class _Dev:
+        def memory_stats(self):
+            return {"bytes_in_use": 123, "peak_bytes_in_use": 456,
+                    "bytes_limit": 789}
+
+        def __str__(self):
+            return "stub:0"
+
+    oflight.enable(capacity=32)
+    oflight.start_memory_sampler(interval_s=0.01, device=_Dev())
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        mem = [e for e in oflight.snapshot() if e["kind"] == "memory"]
+        if len(mem) >= 2:
+            break
+        time.sleep(0.01)
+    oflight.stop_memory_sampler()
+    assert len(mem) >= 2
+    assert mem[0]["bytes_in_use"] == 123 and mem[0]["peak_bytes_in_use"] == 456
+
+
+# ------------------------------------------------------------- exporter
+
+
+def _mk_span(rank, name, trace, span, parent=None, t0=100.0, dur=0.5, **attrs):
+    rec = {"schema": "dlaf_tpu.obs/2", "kind": "span", "ts": t0, "rank": rank,
+           "name": name, "trace_id": trace, "span_id": span,
+           "t0_s": t0, "dur_s": dur}
+    if parent:
+        rec["parent_id"] = parent
+    rec.update(attrs)
+    return rec
+
+
+def test_export_chrome_trace_structure():
+    records = [
+        _mk_span(0, "gw.request", "tr1", "s1", tenant="alice", t0=100.0, dur=1.0),
+        _mk_span(0, "serve.solve", "tr1", "s2", parent="s1", t0=100.2, dur=0.6),
+        _mk_span(1, "gw.request", "tr2", "s3", tenant="bob", t0=100.1, dur=0.9),
+        _mk_span(1, "phase.potrf", "tr3", "s4", t0=100.3, dur=0.1),  # no tenant
+        {"schema": "dlaf_tpu.obs/2", "kind": "comms", "ts": 101.0, "rank": 0,
+         "rows": [{"collective": "psum", "dtype": "float32", "axis": "gr",
+                   "axis_size": 2, "messages": 3, "bytes": 1024,
+                   "wire_bytes": 2048, "overlapped_wire_bytes": 512}]},
+        {"schema": "dlaf_tpu.obs/2", "kind": "health", "ts": 100.5, "rank": 1,
+         "event": "device_probe", "seconds": 0.01},
+    ]
+    doc = oexport.to_chrome_trace(records)
+    ev = doc["traceEvents"]
+    xs = [e for e in ev if e.get("ph") == "X"]
+    assert len(xs) == 4
+    # per-rank process rows + per-tenant tracks
+    assert {e["pid"] for e in xs} == {0, 1}
+    pnames = {e["pid"]: e["args"]["name"] for e in ev
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pnames == {0: "rank 0", 1: "rank 1"}
+    tnames = {(e["pid"], e["tid"]): e["args"]["name"] for e in ev
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "tenant:alice" in tnames.values()
+    assert "tenant:bob" in tnames.values()
+    assert "tenant:internal" in tnames.values()  # the tenant-less phase span
+    # the child rides its trace's tenant track even without the attr
+    solve = next(e for e in xs if e["name"] == "serve.solve")
+    req = next(e for e in xs if e["name"] == "gw.request" and e["pid"] == 0)
+    assert solve["tid"] == req["tid"]
+    assert solve["args"]["parent_id"] == "s1"
+    # timestamps rebase to the earliest span, in microseconds
+    assert min(e["ts"] for e in xs) == 0.0
+    assert req["dur"] == pytest.approx(1.0 * 1e6)
+    # comms -> counter, health -> instant
+    (ctr,) = [e for e in ev if e.get("ph") == "C"]
+    assert ctr["args"] == {"exposed": 1536.0, "overlapped": 512.0}
+    (inst,) = [e for e in ev if e.get("ph") == "i"]
+    assert inst["name"] == "health:device_probe" and inst["pid"] == 1
+
+
+def test_export_cli_writes_loadable_json(tmp_path):
+    src = str(tmp_path / "m.jsonl")
+    om.enable(src)
+    ospans.enable()
+    with ospans.span("root", tenant="t"):
+        with ospans.span("child"):
+            pass
+    ospans.disable()
+    om.close()
+    out = str(tmp_path / "trace.json")
+    assert oexport.main([src, "-o", out]) == 0
+    doc = json.load(open(out))
+    assert doc["displayTimeUnit"] == "ms"
+    assert sum(1 for e in doc["traceEvents"] if e.get("ph") == "X") == 2
+
+
+# ------------------------------------------------------- report roll-up
+
+
+def test_report_metrics_prints_schema_and_span_rollup(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import report_metrics
+
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    ospans.enable()
+    h = ospans.start_request("gw.request", tenant="alice", op="potrf")
+    t = ospans.mark_phase(h, "gw.queue", h["m0"])
+    t = ospans.mark_phase(h, "serve.solve", t)
+    ospans.finish_request(h, outcome="ok")
+    ospans.disable()
+    om.close()
+    assert report_metrics.summarize(path) == 0
+    out = capsys.readouterr().out
+    assert "dlaf_tpu.obs/2" in out  # satellite: schema version printed
+    assert "-- spans" in out and "gw.request" in out
+    assert "request breakdown" in out and "per-tenant critical path" in out
+    assert "alice" in out
