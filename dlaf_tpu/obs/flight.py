@@ -48,6 +48,11 @@ _dump_dir: str | None = None
 _min_dump_interval_s = 1.0
 _last_dump: dict = {}
 _sampler = None
+#: retention cap on flight_*.json files per dump directory (newest kept);
+#: a chaos run tripping the watchdog repeatedly must not grow dumps
+#: without bound.  Override with the env var; <= 0 disables pruning.
+MAX_DUMPS_ENV = "DLAF_TPU_FLIGHT_MAX_DUMPS"
+DEFAULT_MAX_DUMPS = 32
 
 
 def enable(capacity: int = 1024, dump_dir: str | None = None) -> None:
@@ -150,9 +155,37 @@ def dump(reason: str = "manual", path: str | None = None) -> str:
         json.dump(doc, fh, default=om._jsonable)
         fh.write("\n")
     os.replace(tmp, path)
+    _prune_dumps(os.path.dirname(path) or ".")
     # "flight" is not in _TEE_KINDS, so this cannot re-enter the ring.
     om.emit("flight", reason=reason, path=path, events=len(events))
     return path
+
+
+def _prune_dumps(directory: str) -> None:
+    """Keep only the newest ``DLAF_TPU_FLIGHT_MAX_DUMPS`` flight dumps in
+    ``directory``; never raises (the dump that just succeeded matters more
+    than the cleanup)."""
+    try:
+        cap = int(os.environ.get(MAX_DUMPS_ENV, DEFAULT_MAX_DUMPS))
+    except (TypeError, ValueError):
+        cap = DEFAULT_MAX_DUMPS
+    if cap <= 0:
+        return
+    try:
+        names = [f for f in os.listdir(directory)
+                 if f.startswith("flight_") and f.endswith(".json")]
+        if len(names) <= cap:
+            return
+        # mtime newest-first; the stamped name breaks same-second ties
+        names.sort(key=lambda f: (os.path.getmtime(os.path.join(directory, f)), f),
+                   reverse=True)
+        for f in names[cap:]:
+            try:
+                os.unlink(os.path.join(directory, f))
+            except OSError:
+                pass
+    except OSError:
+        return
 
 
 def auto_dump(reason: str) -> str | None:

@@ -21,9 +21,14 @@ per sink (emitter + flight-recorder tee).  Compile-time records ride
 
 Schema history: ``/1`` is the original record set; ``/2`` adds the
 ``span`` (request-scoped tracing, ``obs.spans``) and ``flight`` (crash
-dump pointers, ``obs.flight``) kinds.  Writers stamp ``/2``; readers
-(:func:`validate_record`, :func:`read_jsonl`) accept both so old BENCH
-and metrics artifacts keep parsing.
+dump pointers, ``obs.flight``) kinds; ``/3`` adds the ``scenario``
+(scenario-run results and replay verdicts, ``dlaf_tpu.scenario``) and
+``capacity`` (service-time fits and replicas-needed predictions,
+``scenario.capacity``) kinds, and stamps ``gw.request`` root spans with
+the replayable request attrs (shape, dtype, deadline, batch group key).
+Writers stamp ``/3``; readers (:func:`validate_record`,
+:func:`read_jsonl`) accept all three so old BENCH and metrics artifacts
+keep parsing.
 """
 from __future__ import annotations
 
@@ -33,9 +38,9 @@ import sys
 import threading
 import time
 
-SCHEMA = "dlaf_tpu.obs/2"
-#: every schema tag a reader accepts (old artifacts carry /1).
-SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2")
+SCHEMA = "dlaf_tpu.obs/3"
+#: every schema tag a reader accepts (old artifacts carry /1 or /2).
+SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2", "dlaf_tpu.obs/3")
 
 #: kind -> payload fields every record of that kind must carry.
 REQUIRED_FIELDS: dict = {
@@ -54,6 +59,9 @@ REQUIRED_FIELDS: dict = {
     # /2 additions:
     "span": ("name", "trace_id", "span_id", "t0_s", "dur_s"),
     "flight": ("reason", "path", "events"),
+    # /3 additions:
+    "scenario": ("event",),
+    "capacity": ("event",),
 }
 
 _emitter = None

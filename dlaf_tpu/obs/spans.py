@@ -3,7 +3,7 @@
 The metrics layer (``obs.metrics``) answers "how much, in aggregate"; this
 module answers "where did THIS request's 200 ms go".  A span is a named,
 wall-clock-bounded interval emitted as a ``"span"`` metrics record
-(schema ``dlaf_tpu.obs/2``) carrying three identity fields:
+(schema ``dlaf_tpu.obs/2`` and later) carrying three identity fields:
 
 ``trace_id``   shared by every span of one logical request,
 ``span_id``    this interval,

@@ -1,0 +1,42 @@
+"""Scenario engine over the serve gateway: declarative workload specs,
+an open-loop chaos-capable runner, deterministic trace replay, and a
+span-fitted capacity model.
+
+* :mod:`dlaf_tpu.scenario.spec` — :class:`Scenario` dataclasses and the
+  named library (``baseline``, ``burst``, ``diurnal``, ``adversarial``,
+  ``replica_storm``, ``mesh_hang``);
+* :mod:`dlaf_tpu.scenario.runner` — :func:`run_scenario` (open-loop,
+  fault timeline, per-scenario SLO gates) and the legacy closed-loop
+  :func:`run_loadgen` behind ``scripts/serve_loadgen.py``;
+* :mod:`dlaf_tpu.scenario.replay` — ``python -m dlaf_tpu.scenario.replay``
+  re-drives a captured span JSONL through a fresh gateway and asserts
+  admission outcomes + batch group keys match the source;
+* :mod:`dlaf_tpu.scenario.capacity` — fits per-bucket service times and
+  an M/G/1-style queueing model from run records and answers
+  ``replicas_needed(req_s, mix, p99_target)``.
+
+``python -m dlaf_tpu.scenario list|show|run`` is the CLI front door.
+"""
+from dlaf_tpu.scenario.spec import (
+    SLO,
+    ArrivalCurve,
+    FaultEvent,
+    OpMix,
+    Scenario,
+    TenantSpec,
+    get,
+    library,
+    names,
+)
+
+__all__ = [
+    "SLO",
+    "ArrivalCurve",
+    "FaultEvent",
+    "OpMix",
+    "Scenario",
+    "TenantSpec",
+    "get",
+    "library",
+    "names",
+]

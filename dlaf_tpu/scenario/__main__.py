@@ -1,0 +1,66 @@
+"""CLI: ``python -m dlaf_tpu.scenario list|show|run``.
+
+``list`` prints the scenario library; ``show <name>`` dumps one spec as
+JSON (the ``from_dict`` round-trip format); ``run <name>`` executes it
+with its SLO gates (exit nonzero on failure).  ``replay`` and
+``capacity`` live in their own submodules
+(``python -m dlaf_tpu.scenario.replay`` / ``...capacity``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m dlaf_tpu.scenario",
+                                 description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="list the scenario library")
+    p_show = sub.add_parser("show", help="dump one scenario spec as JSON")
+    p_show.add_argument("name")
+    p_run = sub.add_parser("run", help="execute one scenario with its SLO gates")
+    p_run.add_argument("name")
+    p_run.add_argument("--requests", type=int, default=None,
+                       help="override the spec's request count")
+    p_run.add_argument("--out", default=None, help="metrics JSONL path")
+    p_run.add_argument("--trace-out", default=None,
+                       help="also trace spans and write Chrome-trace JSON")
+    p_run.add_argument("--time-scale", type=float, default=1.0,
+                       help="compress (<1) or stretch (>1) the timeline")
+    args = ap.parse_args(argv)
+
+    # force the CPU mesh before jax initializes (same contract as the
+    # serve_loadgen script): scenarios run on the 8-device host mesh.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+
+    from dlaf_tpu import scenario
+
+    if args.cmd == "list":
+        for name in scenario.names():
+            s = scenario.get(name)
+            faults = f", {len(s.faults)} fault(s)" if s.faults else ""
+            print(f"{name:>16s}  {len(s.tenants)} tenants, "
+                  f"{s.replicas} replicas{faults} — {s.description}")
+        return 0
+    if args.cmd == "show":
+        print(json.dumps(scenario.get(args.name).to_dict(), indent=2))
+        return 0
+
+    from dlaf_tpu.scenario import runner
+
+    result = runner.run_scenario(scenario.get(args.name),
+                                 requests=args.requests, out=args.out,
+                                 trace_out=args.trace_out,
+                                 time_scale=args.time_scale)
+    return 0 if result.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,367 @@
+"""Capacity model: fit service times + queueing from scenario runs and
+answer ``replicas_needed(req_s, mix, p99_target)``.
+
+The model has three measured layers, all fitted from metrics JSONL
+records a scenario/loadgen run already emits:
+
+* **service fit** — per (op, bucket) class, a linear model
+  ``batch_seconds = a + b * batch_size`` least-squares fitted from the
+  pool's ``batch`` serve events (``n_reqs``/``seconds``), plus the
+  measured mean per-request service time ``s_c`` (total batch seconds /
+  total batched requests) at the fill levels the runs actually hit;
+* **queueing** — replicas dispatch serially (the pool's process-wide
+  execution lock), so each replica is an M/G/1-style server with
+  utilization ``rho = sum_c lambda_c * s_c`` and mean wait
+  ``rho/(1-rho) * s_mean``; modeled latency adds the gateway linger and
+  the mean dispatch (batch) time.  This form is monotone in offered
+  load and in 1/replicas by construction;
+* **calibration** — the ratio of each training run's observed p99 to its
+  modeled latency; the median ratio scales model output into p99 space,
+  and the ratio spread across runs states the confidence (``high`` when
+  all runs agree within 2x, ``medium`` within 4x, else ``low``).
+
+``python -m dlaf_tpu.scenario.capacity train.jsonl ... --holdout h.jsonl
+--assert-within 1`` fits on the training runs and checks the prediction
+against what the held-out run actually used; ``--out`` writes ``capacity``
+records that ``scripts/report_metrics.py`` renders as the fit/prediction
+table.
+"""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+
+from dlaf_tpu.health import ConfigurationError
+
+#: utilization ceiling: beyond this the queueing term is considered
+#: divergent and the replica count infeasible.
+RHO_MAX = 0.95
+
+
+@dataclass(frozen=True)
+class ServiceFit:
+    """Per-(op, bucket) service model: ``seconds(batch) = a + b*batch``
+    and the measured mean per-request seconds at observed fill."""
+
+    a: float
+    b: float
+    per_req_s: float
+    batches: int
+    requests: int
+
+
+@dataclass(frozen=True)
+class RunObs:
+    """One run's aggregate observation: offered load, class mix, replica
+    count, and the worst per-tenant p99."""
+
+    name: str
+    req_s: float
+    mix: dict
+    replicas: int
+    p99_s: float
+    linger_s: float
+    requests: int
+
+
+@dataclass(frozen=True)
+class Prediction:
+    replicas: int
+    predicted_p99_s: float
+    confidence: str
+    rho: float
+    feasible: bool
+
+
+def _fit_line(xs, ys) -> tuple:
+    """Least-squares ``y = a + b x`` (b clamped >= 0; degenerate x spread
+    collapses to the mean)."""
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    if sxx <= 0:
+        return my, 0.0
+    b = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / sxx
+    b = max(b, 0.0)
+    return my - b * mx, b
+
+
+def _extract_batches(records) -> dict:
+    """(op, bucket) -> list of (batch_size, seconds) from serve ``batch``
+    events."""
+    out = {}
+    for rec in records:
+        if rec.get("kind") != "serve" or rec.get("event") != "batch":
+            continue
+        key = (str(rec.get("op", "?")), int(rec.get("bucket", 0)))
+        out.setdefault(key, []).append(
+            (int(rec["batch"]), float(rec["seconds"])))
+    return out
+
+
+def _extract_run(records, name: str) -> RunObs | None:
+    """One run's RunObs from its record stream (None when the stream has
+    no completed requests)."""
+    done = [r for r in records
+            if r.get("kind") == "serve" and r.get("event") == "request_done"]
+    if not done:
+        return None
+    ts = [float(r["ts"]) for r in done]
+    span_s = max(ts) - min(ts)
+    mix: dict = {}
+    for r in done:
+        key = (str(r.get("op", "?")), int(r.get("bucket", 0)))
+        mix[key] = mix.get(key, 0) + 1
+    total = sum(mix.values())
+    mix = {k: v / total for k, v in mix.items()}
+    # internal tenants (e.g. the scenario runner's "_warmup" compile pass)
+    # carry cold-compile latency, not steady-state service — keep them out
+    # of the p99 the calibration ratio is anchored on
+    slo = [r for r in records
+           if r.get("kind") == "serve" and r.get("event") == "gw_slo"
+           and r.get("done_ok")
+           and not str(r.get("tenant", "")).startswith("_")]
+    p99 = max((float(r["p99_s"]) for r in slo), default=0.0)
+    replicas = 1
+    linger_s = 0.025
+    for r in records:
+        if r.get("kind") == "run_meta":
+            replicas = int(r.get("replicas", replicas))
+            linger_s = float(r.get("linger_ms", linger_s * 1e3)) * 1e-3
+    return RunObs(name=name, req_s=total / span_s if span_s > 0 else float(total),
+                  mix=mix, replicas=replicas, p99_s=p99, linger_s=linger_s,
+                  requests=total)
+
+
+class CapacityModel:
+    """Fitted service + queueing + calibration state; query with
+    :meth:`predict_p99` / :meth:`replicas_needed`."""
+
+    def __init__(self, fits: dict, runs: list, calibration: float,
+                 ratios: list):
+        self.fits = fits
+        self.runs = runs
+        self.calibration = calibration
+        self.ratios = ratios
+
+    # -------------------------------------------------------------- fitting
+
+    @classmethod
+    def fit_records(cls, record_sets: list, names: list | None = None
+                    ) -> "CapacityModel":
+        """Fit from already-parsed record streams (one list per run)."""
+        names = names or [f"run{i}" for i in range(len(record_sets))]
+        samples: dict = {}
+        runs = []
+        for recs, name in zip(record_sets, names):
+            for key, pts in _extract_batches(recs).items():
+                samples.setdefault(key, []).extend(pts)
+            obs = _extract_run(recs, name)
+            if obs is not None:
+                runs.append(obs)
+        if not samples or not runs:
+            raise ConfigurationError(
+                "capacity: no serve batch/gw_done records to fit from — "
+                "fit needs at least one run with completed requests")
+        fits = {}
+        for key, pts in samples.items():
+            # trim cold-compile outliers: the first dispatch of a group
+            # carries the XLA compile (seconds >> steady state) and would
+            # dominate the intercept of a small-batch fit
+            secs = sorted(p[1] for p in pts)
+            med = secs[len(secs) // 2]
+            kept = [p for p in pts if p[1] <= 5.0 * med] or pts
+            xs = [p[0] for p in kept]
+            ys = [p[1] for p in kept]
+            a, b = _fit_line(xs, ys)
+            tot_req = sum(xs)
+            fits[key] = ServiceFit(a=a, b=b,
+                                   per_req_s=sum(ys) / max(tot_req, 1),
+                                   batches=len(pts), requests=tot_req)
+        model = cls(fits, runs, calibration=1.0, ratios=[])
+        ratios = []
+        for obs in runs:
+            base = model._base_latency(obs.req_s, obs.mix, obs.replicas,
+                                       obs.linger_s)
+            if base is not None and base > 0 and obs.p99_s > 0:
+                ratios.append(obs.p99_s / base)
+        if ratios:
+            ratios.sort()
+            model.calibration = ratios[len(ratios) // 2]
+            model.ratios = ratios
+        return model
+
+    @classmethod
+    def fit(cls, paths: list) -> "CapacityModel":
+        """Fit from metrics JSONL files, one run per file."""
+        from dlaf_tpu.obs import metrics as om
+
+        return cls.fit_records([list(om.read_jsonl(p)) for p in paths],
+                               names=list(paths))
+
+    # ------------------------------------------------------------- querying
+
+    def _class_service(self, key) -> float:
+        """Mean per-request service seconds for a class; unseen classes
+        borrow the global mean (stated by lower confidence, not a crash)."""
+        f = self.fits.get(key)
+        if f is not None:
+            return f.per_req_s
+        tot_req = sum(f.requests for f in self.fits.values())
+        tot_s = sum(f.per_req_s * f.requests for f in self.fits.values())
+        return tot_s / max(tot_req, 1)
+
+    def _base_latency(self, req_s: float, mix: dict, replicas: int,
+                      linger_s: float = 0.025) -> float | None:
+        """Uncalibrated modeled latency (seconds) at the given offered
+        load; None when the utilization exceeds :data:`RHO_MAX`."""
+        rho = self.utilization(req_s, mix, replicas)
+        if rho >= RHO_MAX:
+            return None
+        s_mean = sum(self._class_service(k) * frac for k, frac in mix.items())
+        dispatch_s = max((f.a + f.b for f in self.fits.values()), default=s_mean)
+        wait_s = rho / (1.0 - rho) * s_mean
+        return linger_s + dispatch_s + wait_s
+
+    def utilization(self, req_s: float, mix: dict, replicas: int) -> float:
+        """Per-replica utilization ``rho`` at the given offered load."""
+        lam = req_s / max(replicas, 1)
+        return sum(lam * frac * self._class_service(k)
+                   for k, frac in mix.items())
+
+    def predict_p99(self, req_s: float, mix: dict, replicas: int,
+                    linger_s: float = 0.025) -> float | None:
+        """Calibrated p99 estimate (seconds); None when infeasible."""
+        base = self._base_latency(req_s, mix, replicas, linger_s)
+        return None if base is None else self.calibration * base
+
+    def confidence(self) -> str:
+        if len(self.runs) < 2 or len(self.ratios) < 2:
+            return "low"
+        spread = self.ratios[-1] / max(self.ratios[0], 1e-9)
+        if spread <= 2.0:
+            return "high"
+        if spread <= 4.0:
+            return "medium"
+        return "low"
+
+    def replicas_needed(self, req_s: float, mix: dict, p99_target_s: float,
+                        max_replicas: int = 64,
+                        linger_s: float = 0.025) -> Prediction:
+        """Smallest replica count whose calibrated p99 estimate meets the
+        target.  Monotone: higher ``req_s`` never yields fewer replicas
+        (utilization and wait are strictly increasing in per-replica
+        load)."""
+        if not req_s > 0 or not p99_target_s > 0:
+            raise ConfigurationError(
+                f"capacity: req_s and p99_target_s must be > 0 "
+                f"(got {req_s}, {p99_target_s})")
+        for r in range(1, max_replicas + 1):
+            p99 = self.predict_p99(req_s, mix, r, linger_s)
+            if p99 is not None and p99 <= p99_target_s:
+                return Prediction(replicas=r, predicted_p99_s=p99,
+                                  confidence=self.confidence(),
+                                  rho=self.utilization(req_s, mix, r),
+                                  feasible=True)
+        p99 = self.predict_p99(req_s, mix, max_replicas, linger_s)
+        return Prediction(replicas=max_replicas,
+                          predicted_p99_s=p99 if p99 is not None else float("inf"),
+                          confidence=self.confidence(),
+                          rho=self.utilization(req_s, mix, max_replicas),
+                          feasible=False)
+
+
+def replicas_needed(model: CapacityModel, req_s: float, mix: dict,
+                    p99_target_s: float, **kw) -> Prediction:
+    """Module-level convenience: ``model.replicas_needed(...)``."""
+    return model.replicas_needed(req_s, mix, p99_target_s, **kw)
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _emit_capacity(model: CapacityModel, pred: Prediction, holdout: RunObs,
+                   target_s: float) -> None:
+    from dlaf_tpu.obs import metrics as om
+
+    for (op, bucket), f in sorted(model.fits.items()):
+        om.emit("capacity", event="fit", op=op, bucket=bucket,
+                a_s=f.a, b_s=f.b, per_req_s=f.per_req_s,
+                batches=f.batches, requests=f.requests)
+    om.emit("capacity", event="prediction", run=holdout.name,
+            req_s=holdout.req_s, p99_target_s=target_s,
+            replicas_needed=pred.replicas, observed_replicas=holdout.replicas,
+            predicted_p99_s=pred.predicted_p99_s, rho=pred.rho,
+            confidence=pred.confidence, feasible=pred.feasible,
+            calibration=model.calibration, runs=len(model.runs))
+
+
+def main(argv=None) -> int:
+    from dlaf_tpu.obs import metrics as om
+
+    ap = argparse.ArgumentParser(
+        description="fit the capacity model and predict replicas_needed "
+                    "for a held-out run")
+    ap.add_argument("train", nargs="+", help="training metrics JSONL files")
+    ap.add_argument("--holdout", required=True,
+                    help="held-out run to predict (metrics JSONL)")
+    ap.add_argument("--p99-target-s", type=float, default=None,
+                    help="p99 target; default: 1.25x the held-out run's "
+                         "observed p99 (25%% tolerance for calibration "
+                         "spread between runs)")
+    ap.add_argument("--assert-within", type=int, default=None,
+                    help="exit nonzero unless |predicted - observed| <= N")
+    ap.add_argument("--out", default=None,
+                    help="write capacity fit/prediction records here")
+    args = ap.parse_args(argv)
+
+    model = CapacityModel.fit(args.train)
+    holdout = _extract_run(list(om.read_jsonl(args.holdout)), args.holdout)
+    if holdout is None:
+        print(f"capacity: holdout {args.holdout} has no completed requests")
+        return 1
+    # self-comparison at the holdout's exact achieved p99 is a coin flip
+    # when latency is floor-dominated (linger + dispatch): any calibration
+    # spread between runs flips feasibility.  Allow 25% tolerance.
+    target = args.p99_target_s if args.p99_target_s is not None \
+        else max(holdout.p99_s * 1.25, 1e-3)
+    pred = model.replicas_needed(holdout.req_s, holdout.mix, target,
+                                 linger_s=holdout.linger_s)
+
+    print(f"== capacity model: {len(model.fits)} service classes from "
+          f"{len(model.runs)} runs (calibration x{model.calibration:.2f}, "
+          f"confidence {pred.confidence})")
+    print(f"   {'op':>8s} {'bucket':>7s} {'a ms':>8s} {'b ms/req':>9s} "
+          f"{'mean/req ms':>12s} {'batches':>8s}")
+    for (op, bucket), f in sorted(model.fits.items()):
+        print(f"   {op:>8s} {bucket:7d} {f.a * 1e3:8.2f} {f.b * 1e3:9.3f} "
+              f"{f.per_req_s * 1e3:12.2f} {f.batches:8d}")
+    print(f"   holdout {holdout.name}: {holdout.req_s:.0f} req/s, "
+          f"observed replicas={holdout.replicas}, p99={holdout.p99_s * 1e3:.1f} ms")
+    print(f"   -> replicas_needed(req_s={holdout.req_s:.0f}, "
+          f"p99<={target * 1e3:.1f} ms) = {pred.replicas} "
+          f"(predicted p99 {pred.predicted_p99_s * 1e3:.1f} ms, "
+          f"rho={pred.rho:.2f}, confidence {pred.confidence})")
+
+    if args.out:
+        om.enable(args.out)
+        om.emit_run_meta("capacity", scenario="capacity",
+                         seed=0, requests=holdout.requests)
+        _emit_capacity(model, pred, holdout, target)
+        om.close()
+
+    if args.assert_within is not None:
+        delta = abs(pred.replicas - holdout.replicas)
+        ok = delta <= args.assert_within and pred.feasible
+        print(("PASS" if ok else "FAIL")
+              + f"  capacity prediction within +/-{args.assert_within} "
+                f"of observed ({pred.replicas} vs {holdout.replicas})")
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

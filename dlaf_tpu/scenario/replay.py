@@ -1,0 +1,305 @@
+"""Trace replay: re-drive a captured span JSONL through a fresh gateway.
+
+A run captured with span tracing enabled carries one ``gw.request`` root
+per ADMITTED request, stamped (since obs schema /3) with everything
+needed to rebuild it: tenant, op, uplo, n, rhs width, dtype, deadline,
+the batch group key the pool computed, and the admission outcome.
+:func:`load_schedule` turns those roots into an arrival-ordered schedule;
+:func:`run_replay` re-drives it through a fresh gateway and
+:func:`compare` checks determinism:
+
+* **group keys** — ``serve.make_request`` over the rebuilt operands must
+  produce exactly the recorded ``group`` string for every request (the
+  batching decision is a pure function of shape/op/dtype/buckets);
+* **admission outcomes** — each replayed request must land in the same
+  outcome class (``ok`` / ``deadline`` / ``shed``) as the source.
+
+Quota and queue-full sheds happen at admission BEFORE the root span
+opens, so a trace only ever describes admitted requests; the replay
+gateway is therefore sized quota-free (no token buckets, queues >= the
+trace length) so re-admission never sheds spuriously, and the only
+deterministic evictions left are the recorded already-expired deadlines.
+
+CLI::
+
+    python -m dlaf_tpu.scenario.replay run.jsonl [--out replay.jsonl]
+        [--assert-match] [--time-scale 0.5] [--linger-ms 25]
+
+Exit is nonzero with ``--assert-match`` if any outcome class or group
+key diverges — a captured CI artifact becomes a regression case.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from dlaf_tpu.health import (
+    ConfigurationError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    QueueFullError,
+    TenantQuotaExceededError,
+)
+from dlaf_tpu.obs import metrics as om
+
+#: span outcome values -> replay outcome class.
+_OUTCOME_CLASS = {
+    "ok": "ok",
+    "DeadlineExceededError": "deadline",
+    "TenantQuotaExceededError": "shed",
+    "QueueFullError": "shed",
+    "DeviceUnresponsiveError": "shed",
+}
+
+
+def outcome_class(outcome: str) -> str:
+    """Collapse a recorded root-span outcome into its replay class."""
+    return _OUTCOME_CLASS.get(outcome, "error")
+
+
+def _exc_class(exc) -> str:
+    if exc is None:
+        return "ok"
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline"
+    if isinstance(exc, (TenantQuotaExceededError, QueueFullError,
+                        DeviceUnresponsiveError)):
+        return "shed"
+    return "error"
+
+
+@dataclass(frozen=True)
+class ReplayItem:
+    """One admitted request reconstructed from its ``gw.request`` root."""
+
+    t0_s: float
+    tenant: str
+    op: str
+    uplo: str
+    n: int
+    k: int | None
+    dtype: str
+    deadline_s: float | None
+    group: str
+    outcome: str
+
+    @property
+    def cls(self) -> str:
+        return outcome_class(self.outcome)
+
+
+def load_schedule(records) -> tuple:
+    """(items, meta): the replayable schedule from a metrics record
+    stream.  ``meta`` carries the source run's gateway sizing out of
+    ``run_meta`` (buckets/max_batch/linger_ms) when stamped."""
+    meta = {}
+    items = []
+    t_min = None
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "run_meta":
+            meta = {key: rec[key] for key in
+                    ("scenario", "seed", "buckets", "max_batch", "linger_ms")
+                    if key in rec}
+        if kind != "span" or rec.get("name") != "gw.request":
+            continue
+        if "n" not in rec or "group" not in rec:
+            raise ConfigurationError(
+                "replay: trace predates obs schema /3 — gw.request roots "
+                "carry no shape/group attrs; recapture with a current build")
+        items.append(ReplayItem(
+            t0_s=float(rec["t0_s"]),
+            tenant=str(rec["tenant"]),
+            op=str(rec["op"]),
+            uplo=str(rec.get("uplo", "L")),
+            n=int(rec["n"]),
+            k=None if rec.get("k") is None else int(rec["k"]),
+            dtype=str(rec.get("dtype", "<f4")),
+            deadline_s=(None if rec.get("deadline_s") is None
+                        else float(rec["deadline_s"])),
+            group=str(rec["group"]),
+            outcome=str(rec.get("outcome", "ok")),
+        ))
+        t_min = rec["t0_s"] if t_min is None else min(t_min, rec["t0_s"])
+    items.sort(key=lambda it: it.t0_s)
+    if t_min is not None:
+        items = [dataclass_replace(it, t0_s=it.t0_s - t_min) for it in items]
+    return items, meta
+
+
+def dataclass_replace(item, **kw):
+    import dataclasses
+
+    return dataclasses.replace(item, **kw)
+
+
+def _operand_bank(items) -> dict:
+    """Deterministic SPD + RHS operands per (n, k, dtype) — replay only
+    needs shape/dtype fidelity, not the original values (group keys and
+    admission outcomes are value-independent)."""
+    from dlaf_tpu.testing import random_hermitian_pd, random_matrix
+
+    bank = {}
+    for it in items:
+        key = (it.n, it.k, it.dtype)
+        if key in bank:
+            continue
+        dt = np.dtype(it.dtype)
+        a = random_hermitian_pd(it.n, dt, seed=1000 + it.n)
+        b = (random_matrix(it.n, it.k, dt, seed=2000 + it.n)
+             if it.k is not None else None)
+        bank[key] = (a, b)
+    return bank
+
+
+def check_group_keys(items, bank, buckets: str = "16,32,48") -> list:
+    """Recompute each item's batch group key from its rebuilt operands
+    under the source run's bucket ladder (group keys embed the bucket);
+    returns mismatches as (index, recorded, recomputed)."""
+    from dlaf_tpu import serve, tune
+
+    tune.initialize(serve_buckets=str(buckets))
+    try:
+        bad = []
+        for i, it in enumerate(items):
+            a, b = bank[(it.n, it.k, it.dtype)]
+            req = serve.make_request(it.op, it.uplo, a, b, deadline_s=None)
+            got = str(req.group_key())
+            if got != it.group:
+                bad.append((i, it.group, got))
+        return bad
+    finally:
+        tune.initialize()
+
+
+async def _drive_replay(gw, items, bank, time_scale: float) -> list:
+    """Submit every item at its (scaled) recorded offset; returns the
+    outcome class per item, index-aligned."""
+    out = [None] * len(items)
+    t0 = time.monotonic()
+
+    async def one(i, it):
+        delay = t0 + it.t0_s * time_scale - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        a, b = bank[(it.n, it.k, it.dtype)]
+        try:
+            await gw.submit(it.tenant, it.op, it.uplo, a, b,
+                            deadline_s=it.deadline_s)
+            out[i] = "ok"
+        except Exception as exc:  # noqa: BLE001 - classified below
+            out[i] = _exc_class(exc)
+
+    await asyncio.gather(*(one(i, it) for i, it in enumerate(items)))
+    return out
+
+
+def run_replay(items, meta=None, *, time_scale: float = 1.0) -> list:
+    """Re-drive the schedule through a fresh quota-free gateway; returns
+    the replayed outcome class per item."""
+    from dlaf_tpu import serve, tune
+
+    meta = meta or {}
+    tenants = [serve.TenantConfig(name) for name in
+               sorted({it.tenant for it in items})]
+    max_batch = int(meta.get("max_batch", 8))
+    linger_ms = float(meta.get("linger_ms", 25.0))
+    tune.initialize(serve_buckets=str(meta.get("buckets", "16,32,48")))
+    try:
+        # Queues sized past the trace length: replay must never shed on
+        # backpressure the source run did not record (sheds happen before
+        # the root span opens, so they are not in the schedule).
+        bound = max(2 * len(items), 64)
+        pool = serve.SolverPool(block_size=8, max_batch=max_batch,
+                                max_queue=bound)
+        router = serve.Router([serve.Replica("replay0", pool)])
+        try:
+            gw = serve.Gateway(router, tenants, max_queue=bound,
+                               max_batch=max_batch, linger_ms=linger_ms)
+            replayed = asyncio.run(_drive_replay(gw, items, bank=_operand_bank(items),
+                                                 time_scale=time_scale))
+            gw.close()
+        finally:
+            router.close()
+    finally:
+        tune.initialize()
+    return replayed
+
+
+def compare(items, replayed) -> dict:
+    """Per-class source-vs-replay tally plus the index list of outcome
+    divergences."""
+    mismatches = [
+        {"index": i, "tenant": it.tenant, "op": it.op, "n": it.n,
+         "recorded": it.cls, "replayed": got}
+        for i, (it, got) in enumerate(zip(items, replayed)) if it.cls != got
+    ]
+    classes = sorted({it.cls for it in items} | set(replayed))
+    tally = {c: {"recorded": sum(1 for it in items if it.cls == c),
+                 "replayed": replayed.count(c)} for c in classes}
+    return {"total": len(items), "mismatches": mismatches, "tally": tally}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="re-drive a captured span JSONL through a fresh gateway")
+    ap.add_argument("trace", help="metrics JSONL with gw.request root spans")
+    ap.add_argument("--out", default=None,
+                    help="write the replay's own metrics JSONL here")
+    ap.add_argument("--assert-match", action="store_true",
+                    help="exit nonzero on any outcome/group divergence")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="compress (<1) or stretch (>1) recorded arrival "
+                         "offsets")
+    args = ap.parse_args(argv)
+
+    items, meta = load_schedule(om.read_jsonl(args.trace))
+    if not items:
+        print(f"replay: no gw.request roots in {args.trace}")
+        return 1
+    if args.out:
+        om.enable(args.out)
+        om.emit_run_meta("scenario_replay", scenario=f"replay:{args.trace}",
+                         seed=meta.get("seed", -1), requests=len(items))
+
+    bank = _operand_bank(items)
+    group_bad = check_group_keys(items, bank,
+                                 buckets=meta.get("buckets", "16,32,48"))
+    replayed = run_replay(items, meta, time_scale=args.time_scale)
+    report = compare(items, replayed)
+
+    print(f"== replay {args.trace}: {len(items)} admitted requests "
+          f"(source run: scenario={meta.get('scenario', '?')} "
+          f"seed={meta.get('seed', '?')})")
+    for cls, t in sorted(report["tally"].items()):
+        print(f"   {cls:>10s}: recorded={t['recorded']:<6d} "
+              f"replayed={t['replayed']}")
+    print(f"   group keys: {len(items) - len(group_bad)}/{len(items)} match")
+    for i, rec, got in group_bad[:10]:
+        print(f"   GROUP MISMATCH @{i}: recorded {rec} recomputed {got}")
+    for m in report["mismatches"][:10]:
+        print(f"   OUTCOME MISMATCH @{m['index']}: {m['tenant']}/{m['op']} "
+              f"n={m['n']} recorded={m['recorded']} replayed={m['replayed']}")
+    matched = not group_bad and not report["mismatches"]
+    if args.out:
+        om.emit("scenario", event="replay", scenario=meta.get("scenario", "?"),
+                source=args.trace, total=len(items),
+                outcome_mismatches=len(report["mismatches"]),
+                group_mismatches=len(group_bad), matched=matched)
+        om.close()
+    print(("PASS" if matched else "FAIL")
+          + f"  replay determinism ({len(report['mismatches'])} outcome, "
+            f"{len(group_bad)} group divergences)")
+    if args.assert_match and not matched:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

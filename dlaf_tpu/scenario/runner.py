@@ -1,0 +1,566 @@
+"""Scenario runner: the loadgen core, shared by the thin
+``scripts/serve_loadgen.py`` CLI and the scenario engine.
+
+Two drive modes over the same production path (``Gateway`` admission →
+continuous batching → ``Router`` placement):
+
+* :func:`run_loadgen` — the legacy closed-loop acceptance run: a
+  semaphore-gated all-at-once gather per tenant (``--outstanding`` caps
+  in-flight), with the original SLO checks (typed-shed accounting, fill
+  ratio, percentile ordering, span-chain integrity).
+* :func:`run_scenario` — open-loop execution of a declarative
+  :class:`~dlaf_tpu.scenario.spec.Scenario`: each request is submitted at
+  its precomputed arrival offset regardless of completions (the honest
+  way to probe overload), the fault timeline fires ``testing.faults``
+  injections at scheduled offsets on a worker thread, and the scenario's
+  own :class:`~dlaf_tpu.scenario.spec.SLO` decides pass/fail.
+
+Both stamp ``run_meta`` with the scenario name, seed, and gateway sizing
+so every JSONL artifact is self-identifying (and replayable —
+``scenario.replay`` reads the sizing back out of ``run_meta``).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import defaultdict
+from dataclasses import dataclass
+
+import numpy as np
+
+from dlaf_tpu import serve, tune
+from dlaf_tpu.health import (
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    QueueFullError,
+    TenantQuotaExceededError,
+)
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.scenario import spec as sspec
+from dlaf_tpu.testing import random_hermitian_pd, random_matrix
+
+#: outcome counter keys, in reporting order.
+COUNT_KEYS = ("ok", "solver_info", "shed_quota", "shed_full", "deadline",
+              "failover_shed", "unexpected")
+
+#: tenant the scenario warmup pass submits under: one request per distinct
+#: (op, shape) pre-compiles every group key before the measured timeline
+#: starts, so scenario p99 gates measure queueing, not XLA compiles.  The
+#: tenant is quota-free and excluded from the p99 SLO (its latency IS the
+#: compile time); its requests still count for zero-lost accounting.
+WARMUP_TENANT = "_warmup"
+
+
+def new_counts() -> dict:
+    return {k: 0 for k in COUNT_KEYS}
+
+
+def count_outcome(counts: dict, exc, res=None) -> None:
+    """Classify one request completion into the typed-outcome counters."""
+    if exc is None:
+        counts["ok" if res is not None and res.info == 0 else "solver_info"] += 1
+    elif isinstance(exc, TenantQuotaExceededError):
+        counts["shed_quota"] += 1
+    elif isinstance(exc, QueueFullError):
+        counts["shed_full"] += 1
+    elif isinstance(exc, DeadlineExceededError):
+        counts["deadline"] += 1
+    elif isinstance(exc, DeviceUnresponsiveError):
+        counts["failover_shed"] += 1
+    else:
+        counts["unexpected"] += 1
+        print(f"UNEXPECTED {type(exc).__name__}: {exc}")
+
+
+# ------------------------------------------------- legacy closed-loop pieces
+
+
+def tenant_roster(count: int) -> list:
+    """``count`` tenants with deliberately unequal contracts: an
+    interactive lane-0 tenant, weighted bulk tenants, and one
+    quota-limited tenant whose overage is expected to shed."""
+    roster = [
+        serve.TenantConfig("interactive", lane=0, weight=2.0, max_pending=128),
+        serve.TenantConfig("batch", lane=1, weight=2.0, max_pending=256),
+        serve.TenantConfig("bulk", lane=1, weight=0.5, max_pending=256),
+        serve.TenantConfig("limited", lane=1, weight=1.0, rate=400.0, burst=64,
+                           max_pending=256),
+    ]
+    for i in range(4, count):
+        roster.append(serve.TenantConfig(f"tenant{i}", lane=1, weight=1.0,
+                                         max_pending=256))
+    return roster[:max(count, 1)]
+
+
+def request_plan(n_requests: int, tenants: list, seed: int) -> list:
+    """Deterministic mixed stream: (tenant, kind, n, variant, deadline_s).
+
+    Shapes straddle the three buckets (under-sized requests exercise
+    padding); posv carries one RHS so it groups with its shape peers;
+    eigh stays a small fraction pinned to n=16 (it groups by exact
+    order).  ~1% of requests carry an already-expired deadline to
+    exercise the gateway's deadline eviction path."""
+    rng = np.random.default_rng(seed)
+    names = [t.name for t in tenants]
+    plan = []
+    for _ in range(n_requests):
+        tenant = names[int(rng.integers(len(names)))]
+        roll = rng.random()
+        if roll < 0.10:
+            kind, n = "eigh", 16
+        elif roll < 0.55:
+            kind = "potrf"
+            n = int(rng.choice((12, 16, 24, 32, 40, 48)))
+        else:
+            kind = "posv"
+            n = int(rng.choice((12, 16, 24, 32, 40, 48)))
+        deadline = 0.0 if rng.random() < 0.01 else None
+        plan.append((tenant, kind, n, int(rng.integers(4)), deadline))
+    return plan
+
+
+def problem_bank(shapes=(12, 16, 24, 32, 40, 48), variants: int = 4,
+                 nrhs: int = 1) -> dict:
+    """A small reusable bank of SPD matrices + RHS per (n, variant)."""
+    bank = {}
+    for n in shapes:
+        for v in range(variants):
+            a = random_hermitian_pd(n, np.float32, seed=1000 * n + v)
+            b = random_matrix(n, nrhs, np.float32, seed=2000 * n + v)
+            bank[(n, v)] = (a, b)
+    return bank
+
+
+async def drive(gw, plan, bank, outstanding: int) -> dict:
+    """Closed-loop driver: per-tenant semaphores cap in-flight, every
+    request classified into the typed-outcome counters."""
+    sems = {t: asyncio.Semaphore(outstanding) for t in gw.tenants}
+    counts = new_counts()
+
+    async def one(tenant, kind, n, variant, deadline):
+        a, b = bank[(n, variant)]
+        async with sems[tenant]:
+            try:
+                res = await gw.submit(tenant, kind, "L", a,
+                                      b if kind == "posv" else None,
+                                      deadline_s=deadline)
+                count_outcome(counts, None, res)
+            except Exception as exc:  # noqa: BLE001 - the thing we're counting
+                count_outcome(counts, exc)
+
+    await asyncio.gather(*(one(*req) for req in plan))
+    return counts
+
+
+# --------------------------------------------------- open-loop scenario mode
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled request in a scenario's deterministic timeline."""
+
+    at_s: float
+    tenant: str
+    kind: str
+    n: int
+    variant: int
+    deadline_s: float | None
+
+
+def _apportion(total: int, shares: list) -> list:
+    """Largest-remainder apportionment of ``total`` across ``shares``."""
+    s = sum(shares)
+    raw = [total * sh / s for sh in shares]
+    counts = [int(r) for r in raw]
+    rema = sorted(range(len(raw)), key=lambda i: raw[i] - counts[i],
+                  reverse=True)
+    for i in rema[: total - sum(counts)]:
+        counts[i] += 1
+    return counts
+
+
+def build_schedule(scenario: sspec.Scenario, requests: int | None = None) -> list:
+    """The scenario's full deterministic arrival timeline, sorted by
+    offset.  Each tenant gets its own rng stream seeded
+    ``(scenario.seed, tenant_index)`` so adding a tenant never perturbs
+    the others' draws."""
+    n_total = int(requests if requests is not None else scenario.requests)
+    counts = _apportion(n_total, [t.share for t in scenario.tenants])
+    out = []
+    for idx, (tspec, cnt) in enumerate(zip(scenario.tenants, counts)):
+        rng = np.random.default_rng([scenario.seed, idx])
+        mix = tspec.mix if tspec.mix is not None else scenario.mix
+        for at_s in tspec.arrival.offsets(cnt, rng):
+            kind, n = mix.draw(rng)
+            if tspec.adversarial == "deadline_edge":
+                ladder = sspec.DEADLINE_EDGE_LADDER
+                deadline = float(ladder[int(rng.integers(len(ladder)))])
+            elif rng.random() < tspec.expired_frac:
+                deadline = 0.0
+            else:
+                deadline = None
+            out.append(Arrival(at_s, tspec.name, kind, n,
+                               int(rng.integers(4)), deadline))
+    out.sort(key=lambda a: a.at_s)
+    return out
+
+
+def _chaos_steps(gw, router, fault: sspec.FaultEvent, time_scale: float):
+    """Run one fault window to completion (blocking; called via
+    ``asyncio.to_thread``).  Keeps sweeping ``check_replicas`` inside the
+    window so drains/adoptions happen while the fault holds, then sweeps
+    once after exit so the downed replica is revived."""
+    from dlaf_tpu.testing import faults as tfaults
+
+    hold_s = fault.seconds * time_scale
+
+    def sweep_until(deadline):
+        gw.check_replicas()
+        while True:
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                return
+            time.sleep(min(0.25, rem))
+            gw.check_replicas()
+
+    if fault.kind == "replica_down":
+        with tfaults.replica_down(router, fault.target, seconds=None):
+            sweep_until(time.monotonic() + hold_s)
+    else:  # hang: stall bounded waits past the probe budget
+        with tfaults.hang(fault.seconds):
+            sweep_until(time.monotonic() + hold_s)
+    gw.check_replicas()
+
+
+async def _drive_open_loop(gw, router, schedule, bank, scenario,
+                           time_scale: float) -> dict:
+    """Open-loop: submit each request at its arrival offset, run the
+    fault timeline alongside, classify every completion.  A warmup pass
+    (one request per distinct (kind, n) in the schedule, under
+    :data:`WARMUP_TENANT`) compiles every group key before the clock
+    starts."""
+    counts = new_counts()
+
+    async def warm_one(kind, n):
+        a, b = bank[(n, 0)]
+        await gw.submit(WARMUP_TENANT, kind, "L", a,
+                        b if kind == "posv" else None)
+
+    await asyncio.gather(*(warm_one(kind, n) for kind, n in
+                           sorted({(arr.kind, arr.n) for arr in schedule})))
+    t0 = time.monotonic()
+
+    async def one(arr: Arrival):
+        delay = t0 + arr.at_s * time_scale - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        a, b = bank[(arr.n, arr.variant)]
+        try:
+            res = await gw.submit(arr.tenant, arr.kind, "L", a,
+                                  b if arr.kind == "posv" else None,
+                                  deadline_s=arr.deadline_s)
+            count_outcome(counts, None, res)
+        except Exception as exc:  # noqa: BLE001 - the thing we're counting
+            count_outcome(counts, exc)
+
+    async def chaos(fault: sspec.FaultEvent):
+        delay = t0 + fault.at_s * time_scale - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        await asyncio.to_thread(_chaos_steps, gw, router, fault, time_scale)
+
+    tasks = [one(arr) for arr in schedule]
+    tasks.extend(chaos(f) for f in scenario.faults)
+    await asyncio.gather(*tasks)
+    return counts
+
+
+@dataclass
+class ScenarioResult:
+    """What one scenario run produced: outcome counters, gateway stats,
+    SLO failures (empty == pass)."""
+
+    scenario: sspec.Scenario
+    requests: int
+    counts: dict
+    stats: dict
+    elapsed_s: float
+    failures: list
+
+    @property
+    def passed(self) -> bool:
+        return not self.failures
+
+    @property
+    def req_s(self) -> float:
+        return self.requests / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+
+def evaluate_slos(scenario: sspec.Scenario, counts: dict, stats: dict,
+                  requests: int) -> list:
+    """Check the scenario's SLO block against a finished run; returns the
+    list of human-readable failures (empty == pass)."""
+    fails = []
+    slo = scenario.slo
+    total = sum(counts.values())
+    if total != requests:
+        fails.append(f"accounting: {total} outcomes for {requests} requests")
+    if counts["unexpected"]:
+        fails.append(f"unexpected errors: {counts['unexpected']}")
+    if slo.zero_lost_admitted:
+        for name, t in stats["tenants"].items():
+            if t["pending"] != 0:
+                fails.append(f"lost-admitted: tenant {name} still has "
+                             f"{t['pending']} pending after close")
+            resolved = t["done_ok"] + t["done_err"]
+            if t["admitted"] != resolved:
+                fails.append(f"lost-admitted: tenant {name} admitted "
+                             f"{t['admitted']} but resolved {resolved}")
+    ok = counts["ok"] + counts["solver_info"]
+    if slo.min_ok_frac is not None and ok < slo.min_ok_frac * total:
+        fails.append(f"ok fraction {ok}/{total} below {slo.min_ok_frac}")
+    shed = (counts["shed_quota"] + counts["shed_full"] + counts["deadline"]
+            + counts["failover_shed"])
+    if slo.max_shed_frac is not None and shed > slo.max_shed_frac * total:
+        fails.append(f"shed fraction {shed}/{total} above {slo.max_shed_frac}")
+    if slo.min_fill is not None and stats["batch_fill"] < slo.min_fill:
+        fails.append(f"batch fill {stats['batch_fill']:.2f} below "
+                     f"{slo.min_fill}")
+    if slo.p99_s is not None:
+        # the warmup tenant's latency IS the compile time; every other
+        # tenant ran against warm group keys, which is what the gate means
+        worst = max((t["p99_s"] for name, t in stats["tenants"].items()
+                     if t["done_ok"] and name != WARMUP_TENANT), default=0.0)
+        if worst > slo.p99_s:
+            fails.append(f"p99 {worst:.3f}s above target {slo.p99_s}s")
+    return fails
+
+
+def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
+                 out: str | None = None, trace_out: str | None = None,
+                 time_scale: float = 1.0, quiet: bool = False) -> ScenarioResult:
+    """Execute one scenario end-to-end and evaluate its SLOs.
+
+    ``requests`` overrides the spec's count (the CI lane runs 500);
+    ``time_scale`` compresses/stretches the arrival + fault timeline
+    (tests use < 1).  When ``out`` is set the run's JSONL lands there
+    (including a ``scenario`` result record); ``trace_out`` additionally
+    enables span tracing and writes the Chrome-trace export."""
+    if trace_out and not out:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            "run_scenario: trace_out requires out (spans ride the JSONL "
+            "stream the export reads)")
+    n = int(requests if requests is not None else scenario.requests)
+    schedule = build_schedule(scenario, n)
+    shapes = sorted({arr.n for arr in schedule})
+    bank = problem_bank(shapes=shapes, nrhs=scenario.mix.nrhs)
+
+    if out:
+        om.enable(out)
+    if trace_out:
+        ospans.enable()
+    om.emit_run_meta(
+        "scenario", scenario=scenario.name, seed=scenario.seed,
+        requests=n, replicas=scenario.replicas,
+        buckets=scenario.buckets, max_batch=scenario.max_batch,
+        linger_ms=scenario.linger_ms,
+    )
+    tune.initialize(serve_buckets=scenario.buckets)
+    pools = [serve.SolverPool(block_size=8, max_batch=scenario.max_batch)
+             for _ in range(scenario.replicas)]
+    router = serve.Router([
+        serve.Replica(f"replica{i}", p, probe_budget_s=scenario.probe_budget_s)
+        for i, p in enumerate(pools)
+    ])
+    t0 = time.monotonic()
+    try:
+        tenants = scenario.tenant_configs()
+        tenants.append(serve.TenantConfig(WARMUP_TENANT))
+        gw = serve.Gateway(router, tenants,
+                           max_batch=scenario.max_batch,
+                           linger_ms=scenario.linger_ms)
+        counts = asyncio.run(
+            _drive_open_loop(gw, router, schedule, bank, scenario, time_scale))
+        gw.close()
+        stats = gw.stats()
+    finally:
+        router.close()
+        tune.initialize()
+    elapsed = time.monotonic() - t0
+
+    failures = evaluate_slos(scenario, counts, stats, n)
+    om.emit("scenario", event="result", scenario=scenario.name,
+            seed=scenario.seed, requests=n, elapsed_s=elapsed,
+            passed=not failures, failures=failures, counts=counts,
+            batch_fill=stats["batch_fill"], batches=stats["batches"])
+    if trace_out:
+        ospans.disable()
+    if out:
+        _export_trace(out, trace_out)
+        om.close()
+
+    result = ScenarioResult(scenario=scenario, requests=n, counts=counts,
+                            stats=stats, elapsed_s=elapsed, failures=failures)
+    if not quiet:
+        print_scenario_result(result)
+    return result
+
+
+def _export_trace(out: str, trace_out: str | None) -> None:
+    """Write the Chrome-trace export next to the JSONL (before ``close``
+    merges part files — single-process runs only have the main part)."""
+    if not trace_out:
+        return
+    import json
+
+    from dlaf_tpu.obs import export as oexport
+
+    doc = oexport.to_chrome_trace(om.read_jsonl(out))
+    with open(trace_out, "w") as fh:
+        json.dump(doc, fh)
+        fh.write("\n")
+
+
+def print_scenario_result(result: ScenarioResult) -> None:
+    scn = result.scenario
+    st = result.stats
+    print(f"\n== scenario {scn.name!r} (seed {scn.seed}): {result.requests} "
+          f"requests, {len(scn.tenants)} tenants, {scn.replicas} replicas, "
+          f"{result.elapsed_s:.1f}s ({result.req_s:.0f} req/s)")
+    print("   outcomes: "
+          + "  ".join(f"{k}={v}" for k, v in result.counts.items() if v))
+    print(f"   batches: {st['batches']}  dispatched: {st['dispatched']}  "
+          f"mean fill: {st['batch_fill']:.2f}")
+    for name, t in sorted(st["tenants"].items()):
+        shed = t["shed_quota"] + t["shed_full"]
+        evict = t["evict_deadline"] + t["evict_priority"]
+        print(f"   {name:>16s} admitted={t['admitted']:<6d} ok={t['done_ok']:<6d} "
+              f"shed={shed:<5d} evict={evict:<5d} "
+              f"p99={t['p99_s'] * 1e3:8.1f} ms")
+    for f in result.failures:
+        print(f"   SLO FAIL: {f}")
+    print(("PASS" if result.passed else "FAIL") + f"  scenario {scn.name}")
+
+
+# -------------------------------------------------------- legacy entry point
+
+
+def run_loadgen(args) -> int:
+    """The original closed-loop loadgen acceptance run (the CI
+    serve-loadgen lane).  ``args`` is the argparse namespace from
+    ``scripts/serve_loadgen.py``; returns the process exit code."""
+    om.enable(args.out)
+    if args.trace_out:
+        ospans.enable()
+    om.emit_run_meta(
+        "serve_loadgen", scenario="loadgen", seed=args.seed,
+        requests=args.requests, replicas=args.replicas,
+        buckets="16,32,48", max_batch=args.batch, linger_ms=args.linger_ms,
+    )
+    tune.initialize(serve_buckets="16,32,48")
+
+    tenants = tenant_roster(args.tenants)
+    plan = request_plan(args.requests, tenants, args.seed)
+    bank = problem_bank()
+    failures = []
+
+    def expect(cond, what):
+        print(("ok  " if cond else "FAIL") + f"  {what}")
+        if not cond:
+            failures.append(what)
+
+    pools = [serve.SolverPool(block_size=8, max_batch=args.batch)
+             for _ in range(max(args.replicas, 1))]
+    router = serve.Router([serve.Replica(f"replica{i}", p)
+                           for i, p in enumerate(pools)])
+    t0 = time.monotonic()
+    try:
+        gw = serve.Gateway(router, tenants, max_batch=args.batch,
+                           linger_ms=args.linger_ms)
+        counts = asyncio.run(drive(gw, plan, bank, args.outstanding))
+        st = gw.stats()
+        gw.close()
+    finally:
+        router.close()
+    elapsed = time.monotonic() - t0
+    ospans.disable()
+    om.close()
+
+    total = sum(counts.values())
+    print(f"\n== serve_loadgen: {total} requests, {len(tenants)} tenants, "
+          f"{len(pools)} replicas, {elapsed:.1f}s "
+          f"({total / elapsed:.0f} req/s)")
+    print("   outcomes: " + "  ".join(f"{k}={v}" for k, v in counts.items() if v))
+    print(f"   batches: {st['batches']}  dispatched: {st['dispatched']}  "
+          f"mean fill: {st['batch_fill']:.2f}")
+    print(f"   {'tenant':>12s} {'admitted':>9s} {'ok':>7s} {'shed':>6s} "
+          f"{'evict':>6s} {'p50 ms':>8s} {'p95 ms':>8s} {'p99 ms':>8s}")
+    for name, t in sorted(st["tenants"].items()):
+        shed = t["shed_quota"] + t["shed_full"]
+        evict = t["evict_deadline"] + t["evict_priority"]
+        print(f"   {name:>12s} {t['admitted']:9d} {t['done_ok']:7d} {shed:6d} "
+              f"{evict:6d} {t['p50_s'] * 1e3:8.1f} {t['p95_s'] * 1e3:8.1f} "
+              f"{t['p99_s'] * 1e3:8.1f}")
+
+    expect(total == args.requests, f"all {args.requests} requests accounted for")
+    expect(counts["unexpected"] == 0,
+           f"zero unhandled errors (got {counts['unexpected']})")
+    expect(counts["ok"] >= 0.8 * args.requests,
+           f"the bulk of the stream completed OK ({counts['ok']}/{args.requests})")
+    expect(st["batch_fill"] >= 0.5,
+           f"continuous batching fill ratio >= 0.5 (got {st['batch_fill']:.2f})")
+    recs = [r for r in om.read_jsonl(args.out) if r["kind"] == "serve"]
+    slo = [r for r in recs if r["event"] == "gw_slo"]
+    expect(len(slo) == len(tenants),
+           f"per-tenant gw_slo roll-up in {args.out} ({len(slo)} records)")
+    expect(all(r["p50_s"] <= r["p95_s"] <= r["p99_s"]
+               for r in slo if r["done_ok"]),
+           "latency percentiles ordered per tenant")
+    done = [r for r in recs if r["event"] == "gw_done"]
+    expect(len(done) == total, f"gw_done per request in the stream ({len(done)})")
+
+    if args.trace_out:
+        import json
+
+        from dlaf_tpu.obs import export as oexport
+
+        allrecs = om.read_jsonl(args.out)
+        sp = [r for r in allrecs if r["kind"] == "span"]
+        doc = oexport.to_chrome_trace(allrecs)
+        with open(args.trace_out, "w") as fh:
+            json.dump(doc, fh)
+            fh.write("\n")
+        roots = [r for r in sp
+                 if r["name"] == "gw.request" and r.get("outcome") == "ok"]
+        kids = defaultdict(list)
+        for r in sp:
+            if r.get("parent_id") is not None:
+                kids[r["parent_id"]].append(r)
+        chain = {"gw.queue", "gw.batch", "gw.dispatch", "pool.queue", "serve.solve"}
+        full = tight = 0
+        for r in roots:
+            ch = kids.get(r["span_id"], [])
+            if chain <= {c["name"] for c in ch}:
+                full += 1
+            csum = sum(c["dur_s"] for c in ch)
+            if abs(csum - r["dur_s"]) <= 0.10 * max(r["dur_s"], 1e-9):
+                tight += 1
+        nr = len(roots)
+        n_ok = counts["ok"] + counts["solver_info"]
+        print(f"   trace: {len(sp)} spans, {nr} completed request roots "
+              f"-> {args.trace_out} ({len(doc['traceEvents'])} events)")
+        expect(nr == n_ok,
+               f"span root per completed request ({nr}/{n_ok})")
+        expect(nr > 0 and full >= 0.95 * nr,
+               f"full submit->queue->batch->dispatch->solve chain on >= 95% "
+               f"of completed requests ({full}/{nr})")
+        expect(nr > 0 and tight >= 0.95 * nr,
+               f"summed child durations within 10% of request latency on "
+               f">= 95% of completed requests ({tight}/{nr})")
+
+    print(("PASS" if not failures else "FAIL")
+          + f"  serve_loadgen ({len(recs)} serve events)")
+    return 1 if failures else 0

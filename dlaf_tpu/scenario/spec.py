@@ -1,0 +1,408 @@
+"""Declarative scenario specs for the serve gateway.
+
+A :class:`Scenario` is a complete, JSON-serializable description of one
+gateway workload: per-tenant open-loop arrival processes (constant,
+diurnal, or burst rate curves sampled by seeded thinning), an op/shape
+mix, optional adversarial tenant behaviours (quota probing, deadline-edge
+probing), a fault timeline (``testing.faults`` hangs and
+``replica_down`` outages at scheduled offsets), and the SLO assertions
+that make the run a pass/fail regression gate.
+
+``library()`` holds the named scenarios the CI ``scenario-gates`` lane
+runs; ``get(name)`` resolves one.  Everything is a frozen dataclass so a
+spec round-trips through ``to_dict``/``from_dict`` (and therefore JSON)
+bit-for-bit — the round-trip is the contract that lets a scenario ride
+in a metrics artifact and be re-run later.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+
+from dlaf_tpu.health import ConfigurationError
+
+#: deadline ladder (seconds) an adversarial ``deadline_edge`` tenant draws
+#: from: already-expired, evict-or-serve borderline, and comfortably slack.
+DEADLINE_EDGE_LADDER = (0.0, 0.05, 0.25, 1.0)
+
+_CURVE_SHAPES = ("constant", "diurnal", "burst")
+_ADVERSARIAL_MODES = (None, "quota_probe", "deadline_edge")
+_FAULT_KINDS = ("replica_down", "hang")
+
+
+@dataclass(frozen=True)
+class ArrivalCurve:
+    """Open-loop arrival-rate curve, in requests/second over run time.
+
+    ``constant`` is a homogeneous Poisson process at ``rate``;
+    ``diurnal`` modulates it by ``1 + amplitude*sin(2pi (t+phase)/period)``
+    (a compressed day); ``burst`` multiplies ``rate`` by ``burst_factor``
+    for the first ``duty`` fraction of every ``period_s`` window.
+    Sampling uses Lewis thinning, so a curve + seeded rng gives the same
+    offsets on every host.
+    """
+
+    shape: str = "constant"
+    rate: float = 50.0
+    period_s: float = 8.0
+    amplitude: float = 0.8
+    burst_factor: float = 4.0
+    duty: float = 0.25
+    phase_s: float = 0.0
+
+    def __post_init__(self):
+        if self.shape not in _CURVE_SHAPES:
+            raise ConfigurationError(
+                f"arrival curve shape {self.shape!r} not in {_CURVE_SHAPES}")
+        if not self.rate > 0:
+            raise ConfigurationError(f"arrival rate must be > 0, got {self.rate}")
+        if not self.period_s > 0:
+            raise ConfigurationError(f"period_s must be > 0, got {self.period_s}")
+        if not 0.0 <= self.amplitude <= 1.0:
+            raise ConfigurationError(
+                f"amplitude must be in [0, 1], got {self.amplitude}")
+        if not self.burst_factor >= 1.0:
+            raise ConfigurationError(
+                f"burst_factor must be >= 1, got {self.burst_factor}")
+        if not 0.0 < self.duty < 1.0:
+            raise ConfigurationError(f"duty must be in (0, 1), got {self.duty}")
+
+    def rate_at(self, t: float) -> float:
+        if self.shape == "constant":
+            return self.rate
+        if self.shape == "diurnal":
+            w = 2.0 * math.pi * (t + self.phase_s) / self.period_s
+            return max(self.rate * (1.0 + self.amplitude * math.sin(w)), 0.0)
+        # burst: square wave, high for the duty fraction of each period
+        phase = (t + self.phase_s) % self.period_s
+        return self.rate * (self.burst_factor
+                            if phase < self.duty * self.period_s else 1.0)
+
+    def peak_rate(self) -> float:
+        if self.shape == "diurnal":
+            return self.rate * (1.0 + self.amplitude)
+        if self.shape == "burst":
+            return self.rate * self.burst_factor
+        return self.rate
+
+    def offsets(self, n: int, rng) -> list:
+        """``n`` arrival offsets (seconds from run start) by thinning a
+        homogeneous process at the curve's peak rate."""
+        rmax = self.peak_rate()
+        t = 0.0
+        out = []
+        while len(out) < n:
+            t += rng.exponential(1.0 / rmax)
+            if rng.random() * rmax <= self.rate_at(t):
+                out.append(t)
+        return out
+
+
+@dataclass(frozen=True)
+class OpMix:
+    """Op/shape mix: relative weights per solver kind plus the shape pool.
+
+    ``eigh`` stays pinned to ``eigh_n`` (it groups by exact order);
+    ``posv`` carries ``nrhs`` right-hand sides so it groups with its
+    shape peers.  Drawing order is fixed (eigh, potrf, posv) so a seeded
+    rng reproduces the stream.
+    """
+
+    potrf: float = 0.45
+    posv: float = 0.45
+    eigh: float = 0.10
+    shapes: tuple = (12, 16, 24, 32, 40, 48)
+    eigh_n: int = 16
+    nrhs: int = 1
+
+    def __post_init__(self):
+        if min(self.potrf, self.posv, self.eigh) < 0 or \
+                not (self.potrf + self.posv + self.eigh) > 0:
+            raise ConfigurationError(
+                f"op mix weights must be >= 0 with a positive sum, got "
+                f"potrf={self.potrf} posv={self.posv} eigh={self.eigh}")
+        if not self.shapes:
+            raise ConfigurationError("op mix needs at least one shape")
+
+    def draw(self, rng) -> tuple:
+        """One (kind, n) draw."""
+        total = self.potrf + self.posv + self.eigh
+        roll = rng.random() * total
+        if roll < self.eigh:
+            return "eigh", int(self.eigh_n)
+        n = int(self.shapes[int(rng.integers(len(self.shapes)))])
+        if roll < self.eigh + self.potrf:
+            return "potrf", n
+        return "posv", n
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OpMix":
+        d = dict(d)
+        d["shapes"] = tuple(d.get("shapes", cls.shapes))
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant: its gateway contract (quota/lane/weight/pending bound),
+    its share of the scenario's request count, its arrival curve, an
+    optional per-tenant mix override, and an optional adversarial mode:
+
+    * ``quota_probe`` — the spec is expected to pair a low token-bucket
+      ``rate`` with a bursty arrival curve so admission rides the quota
+      edge; sheds must stay typed (``TenantQuotaExceededError``).
+    * ``deadline_edge`` — requests draw deadlines from
+      :data:`DEADLINE_EDGE_LADDER`, probing the eviction boundary.
+    """
+
+    name: str
+    share: float = 1.0
+    lane: int = 1
+    weight: float = 1.0
+    rate: float | None = None
+    burst: int = 64
+    max_pending: int | None = None
+    arrival: ArrivalCurve = ArrivalCurve()
+    mix: OpMix | None = None
+    adversarial: str | None = None
+    expired_frac: float = 0.01
+
+    def __post_init__(self):
+        if self.adversarial not in _ADVERSARIAL_MODES:
+            raise ConfigurationError(
+                f"adversarial mode {self.adversarial!r} not in "
+                f"{_ADVERSARIAL_MODES}")
+        if not self.share > 0:
+            raise ConfigurationError(f"tenant share must be > 0, got {self.share}")
+        if not 0.0 <= self.expired_frac <= 1.0:
+            raise ConfigurationError(
+                f"expired_frac must be in [0, 1], got {self.expired_frac}")
+
+    def tenant_config(self):
+        from dlaf_tpu import serve
+
+        return serve.TenantConfig(self.name, rate=self.rate, burst=self.burst,
+                                  weight=self.weight, lane=self.lane,
+                                  max_pending=self.max_pending)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        d = dict(d)
+        if d.get("arrival") is not None:
+            d["arrival"] = ArrivalCurve(**d["arrival"])
+        if d.get("mix") is not None:
+            d["mix"] = OpMix.from_dict(d["mix"])
+        return cls(**d)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: at ``at_s`` seconds into the run, hold the
+    fault for ``seconds``.  ``replica_down`` forces ``target``'s watchdog
+    probe to fail (``testing.faults.replica_down``) so the router's real
+    drain/adopt path runs; ``hang`` injects a bounded-sync stall
+    (``testing.faults.hang``) long enough to blow the probe budget."""
+
+    at_s: float
+    kind: str = "replica_down"
+    seconds: float = 2.0
+    target: str | None = "replica0"
+
+    def __post_init__(self):
+        if self.kind not in _FAULT_KINDS:
+            raise ConfigurationError(
+                f"fault kind {self.kind!r} not in {_FAULT_KINDS}")
+        if self.kind == "replica_down" and not self.target:
+            raise ConfigurationError("replica_down fault needs a target replica")
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Per-scenario pass/fail assertions, evaluated by the runner.  Any
+    ``None`` field is unchecked.  ``zero_lost_admitted`` is the chaos
+    invariant: every admitted request must resolve to a result or a
+    typed shed — no future may be dropped."""
+
+    p99_s: float | None = None
+    min_fill: float | None = None
+    min_ok_frac: float | None = None
+    max_shed_frac: float | None = None
+    zero_lost_admitted: bool = True
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A full scenario: tenants + mix + faults + SLOs + gateway sizing."""
+
+    name: str
+    seed: int = 0
+    requests: int = 1000
+    tenants: tuple = (TenantSpec("t0"),)
+    mix: OpMix = OpMix()
+    faults: tuple = ()
+    slo: SLO = SLO()
+    replicas: int = 2
+    max_batch: int = 8
+    linger_ms: float = 25.0
+    buckets: str = "16,32,48"
+    probe_budget_s: float = 0.5
+    description: str = ""
+
+    def __post_init__(self):
+        if not self.tenants:
+            raise ConfigurationError("scenario needs at least one tenant")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ConfigurationError(f"duplicate tenant names: {names}")
+        if self.replicas < 1 or self.requests < 1:
+            raise ConfigurationError(
+                f"scenario needs >= 1 replica and >= 1 request "
+                f"(replicas={self.replicas}, requests={self.requests})")
+        for f in self.faults:
+            if f.kind == "replica_down" and \
+                    f.target not in {f"replica{i}" for i in range(self.replicas)}:
+                raise ConfigurationError(
+                    f"fault targets unknown replica {f.target!r} "
+                    f"(scenario has {self.replicas})")
+
+    def tenant_configs(self) -> list:
+        return [t.tenant_config() for t in self.tenants]
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        d = dict(d)
+        d["tenants"] = tuple(TenantSpec.from_dict(t) for t in d.get("tenants", ()))
+        if d.get("mix") is not None:
+            d["mix"] = OpMix.from_dict(d["mix"])
+        d["faults"] = tuple(FaultEvent(**f) for f in d.get("faults", ()))
+        if d.get("slo") is not None:
+            d["slo"] = SLO(**d["slo"])
+        return cls(**d)
+
+
+# ----------------------------------------------------------- named library
+
+
+def library() -> dict:
+    """The named scenario library, keyed by name.  Rates are absolute
+    (req/s), so run duration scales with ``requests``; the CI lane runs
+    the 500-request flavour of burst / adversarial / replica_storm.
+    Rates are sized for the 8-device CPU tier-1 mesh (~40 req/s
+    saturated throughput at fill ~1.4): polite scenarios offer ~6-8
+    req/s so queueing stays bounded even on a 3x slower CI runner, and only the adversarial/burst
+    peaks push past capacity on purpose."""
+    scns = (
+        Scenario(
+            "baseline", seed=11, requests=1000, linger_ms=100.0,
+            tenants=(
+                TenantSpec("interactive", share=0.3, lane=0, weight=2.0,
+                           max_pending=128,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+                TenantSpec("batch", share=0.7, max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=5.0)),
+            ),
+            slo=SLO(min_ok_frac=0.9, min_fill=0.1, max_shed_frac=0.1,
+                    p99_s=10.0),
+            description="two polite constant-rate tenants; the capacity "
+                        "model's training anchor",
+        ),
+        Scenario(
+            "burst", seed=7, requests=1000, linger_ms=100.0,
+            tenants=(
+                TenantSpec("steady", share=0.5, max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+                TenantSpec("bursty", share=0.5, max_pending=512,
+                           expired_frac=0.02,
+                           arrival=ArrivalCurve("burst", rate=1.5,
+                                                period_s=4.0, duty=0.25,
+                                                burst_factor=6.0)),
+            ),
+            slo=SLO(min_ok_frac=0.85, min_fill=0.1, max_shed_frac=0.15,
+                    p99_s=25.0),
+            description="square-wave arrival bursts against a steady "
+                        "background; exercises linger/fill under load swings "
+                        "(p99 gate ~2x the locally observed burst-peak tail)",
+        ),
+        Scenario(
+            "diurnal", seed=13, requests=1000, linger_ms=100.0,
+            tenants=(
+                TenantSpec("day", share=0.5, max_pending=256,
+                           arrival=ArrivalCurve("diurnal", rate=4.0,
+                                                period_s=8.0, amplitude=0.9)),
+                TenantSpec("night", share=0.5, max_pending=256,
+                           arrival=ArrivalCurve("diurnal", rate=4.0,
+                                                period_s=8.0, amplitude=0.9,
+                                                phase_s=4.0)),
+            ),
+            slo=SLO(min_ok_frac=0.9, min_fill=0.1, p99_s=15.0),
+            description="two anti-phase sinusoidal tenants — a compressed "
+                        "day/night load cycle",
+        ),
+        Scenario(
+            "adversarial", seed=23, requests=1000, replicas=1,
+            linger_ms=100.0,
+            tenants=(
+                TenantSpec("interactive", share=0.40, lane=0, weight=2.0,
+                           max_pending=128,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+                TenantSpec("quota_prober", share=0.35, rate=2.0, burst=3,
+                           max_pending=64, adversarial="quota_probe",
+                           arrival=ArrivalCurve("burst", rate=2.0,
+                                                period_s=3.0, duty=0.2,
+                                                burst_factor=8.0)),
+                TenantSpec("deadline_prober", share=0.25,
+                           adversarial="deadline_edge", max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=2.5)),
+            ),
+            slo=SLO(min_ok_frac=0.35, max_shed_frac=0.7),
+            description="hostile tenants riding the quota and deadline "
+                        "edges on a single replica; all sheds must stay "
+                        "typed and the interactive lane must stay served",
+        ),
+        Scenario(
+            "replica_storm", seed=31, requests=1000, linger_ms=100.0,
+            tenants=(
+                TenantSpec("steady", share=0.6, max_pending=512,
+                           arrival=ArrivalCurve("constant", rate=4.5)),
+                TenantSpec("interactive", share=0.4, lane=0, weight=2.0,
+                           max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+            ),
+            faults=(FaultEvent(at_s=2.0, kind="replica_down", seconds=3.0,
+                               target="replica0"),),
+            slo=SLO(min_ok_frac=0.85, p99_s=60.0, zero_lost_admitted=True),
+            description="replica0 forced down mid-run via the watchdog "
+                        "probe; the router drain/adopt path must lose zero "
+                        "admitted requests",
+        ),
+        Scenario(
+            "mesh_hang", seed=43, requests=1000, probe_budget_s=0.4,
+            linger_ms=100.0,
+            tenants=(
+                TenantSpec("steady", share=1.0, max_pending=512,
+                           arrival=ArrivalCurve("constant", rate=5.0)),
+            ),
+            faults=(FaultEvent(at_s=2.0, kind="hang", seconds=1.5,
+                               target=None),),
+            slo=SLO(min_ok_frac=0.9, zero_lost_admitted=True),
+            description="a bounded-sync stall longer than the probe budget "
+                        "— every replica looks dead until the stall lifts",
+        ),
+    )
+    return {s.name: s for s in scns}
+
+
+def get(name: str) -> Scenario:
+    lib = library()
+    if name not in lib:
+        raise ConfigurationError(
+            f"unknown scenario {name!r}; library: {sorted(lib)}")
+    return lib[name]
+
+
+def names() -> list:
+    return sorted(library())

@@ -190,10 +190,18 @@ class Gateway:
             # span root opens at admission, anchored at t_submit so the
             # validation cost is inside the request interval; set BEFORE
             # the push — the done-callback (which closes the root) can
-            # fire the moment a dispatcher thread sees the request
-            req.trace = ospans.start_request(
-                "gw.request", t_submit_mono=req.t_submit, tenant=tenant, op=kind
-            )
+            # fire the moment a dispatcher thread sees the request.
+            # The attrs make the root replayable (scenario.replay): shape,
+            # dtype, deadline and the pool's batch group key identify the
+            # request completely without the operand values.
+            if ospans.active():
+                req.trace = ospans.start_request(
+                    "gw.request", t_submit_mono=req.t_submit, tenant=tenant,
+                    op=kind, uplo=uplo, n=req.n,
+                    k=(int(req.b.shape[-1]) if req.b is not None else None),
+                    dtype=str(req.a.dtype.str), deadline_s=deadline_s,
+                    group=str(req.group_key()),
+                )
             req.t_mark = req.t_submit
             self._fq.push((req, cfg), cfg)
             self._cond.notify_all()

@@ -110,6 +110,50 @@ def slow_collective(seconds: float):
 
 
 @contextmanager
+def replica_down(router, name: str, seconds: float | None = None):
+    """Force replica ``name``'s watchdog probe to fail so the NEXT
+    ``Router.check()`` / ``Gateway.check_replicas()`` sweep takes the real
+    drain/adopt failover path — the gateway-level fault a replica-storm
+    scenario is made of.
+
+    With ``seconds=None`` the probe fails for the whole ``with`` block
+    (exit restores the real probe, so a later sweep revives the replica);
+    with a number, the probe recovers on its own after ``seconds`` even
+    inside the block — a transient outage.  Only the probe is patched:
+    drain, adoption, requeue and revive all run production code."""
+    import time
+
+    from dlaf_tpu.health import DeviceUnresponsiveError
+
+    rep = router.get(name)
+    wd = rep.watchdog
+    # ``probe`` is a method on the watchdog class; patch by shadowing it
+    # with an instance attribute and restore by deleting the shadow (so a
+    # pre-existing instance-level override, if any, is put back verbatim).
+    shadow = wd.__dict__.get("probe")
+    orig = wd.probe
+    t0 = time.monotonic()
+
+    def probe(budget_s: float | None = None):
+        if seconds is None or time.monotonic() - t0 < float(seconds):
+            raise DeviceUnresponsiveError(
+                budget_s=float(budget_s if budget_s is not None else 0.0),
+                device=rep.name,
+                message=f"injected outage: replica {rep.name!r} forced down",
+            )
+        return orig(budget_s)
+
+    wd.probe = probe
+    try:
+        yield rep
+    finally:
+        if shadow is not None:
+            wd.probe = shadow
+        else:
+            del wd.__dict__["probe"]
+
+
+@contextmanager
 def preempt_at(panel: int, algo: str | None = None):
     """Simulate preemption: kill the driver (raise :class:`PreemptedError`)
     at the FIRST panel boundary with ``panel_index >= panel`` (of ``algo``

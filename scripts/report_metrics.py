@@ -97,6 +97,13 @@ def summarize(path: str) -> int:
               f"jax {r['jax_version']}  backend {r['backend']}  "
               f"{r['process_count']} proc x {r.get('local_device_count', '?')} dev "
               f"({r['device_count']} total)")
+        # self-identifying artifacts: scenario name + seed (+ sizing) when
+        # the run stamped them (loadgen/scenario/replay runs do)
+        ident = "  ".join(f"{k}={r[k]}" for k in
+                          ("scenario", "seed", "requests", "replicas")
+                          if k in r)
+        if ident:
+            print(f"   {ident}")
         print(f"   argv: {' '.join(r['argv'])}")
 
     for r in by_kind.get("config", []):
@@ -379,6 +386,53 @@ def summarize(path: str) -> int:
             if fo:
                 print("   failover: "
                       + "  ".join(f"{e}={n}" for e, n in sorted(fo.items())))
+
+    for r in by_kind.get("scenario", []):
+        if r["event"] == "result":
+            counts = r.get("counts", {})
+            outcome = "  ".join(f"{k}={v}" for k, v in counts.items() if v)
+            print(f"-- scenario {r.get('scenario', '?')!r} (seed "
+                  f"{r.get('seed', '?')}): "
+                  f"{'PASS' if r.get('passed') else 'FAIL'}  "
+                  f"{r.get('requests', '?')} requests in "
+                  f"{r.get('elapsed_s', 0.0):.1f}s, "
+                  f"fill {r.get('batch_fill', 0.0):.2f}")
+            if outcome:
+                print(f"   outcomes: {outcome}")
+            for f in r.get("failures", []):
+                print(f"   SLO FAIL: {f}")
+        elif r["event"] == "replay":
+            print(f"-- replay of {r.get('source', '?')} "
+                  f"(scenario {r.get('scenario', '?')!r}): "
+                  f"{'MATCH' if r.get('matched') else 'DIVERGED'}  "
+                  f"{r.get('total', '?')} requests, "
+                  f"{r.get('outcome_mismatches', 0)} outcome / "
+                  f"{r.get('group_mismatches', 0)} group-key divergences")
+
+    cap_recs = by_kind.get("capacity", [])
+    if cap_recs:
+        fits = [r for r in cap_recs if r["event"] == "fit"]
+        preds = [r for r in cap_recs if r["event"] == "prediction"]
+        print(f"-- capacity model ({len(fits)} service classes, "
+              f"{len(preds)} predictions):")
+        if fits:
+            print(f"   {'op':>8s} {'bucket':>7s} {'a ms':>8s} {'b ms/req':>9s} "
+                  f"{'mean/req ms':>12s} {'batches':>8s}")
+            for r in sorted(fits, key=lambda r: (r.get("op", ""),
+                                                 r.get("bucket", 0))):
+                print(f"   {r.get('op', '?'):>8s} {r.get('bucket', 0):7d} "
+                      f"{r.get('a_s', 0.0) * 1e3:8.2f} "
+                      f"{r.get('b_s', 0.0) * 1e3:9.3f} "
+                      f"{r.get('per_req_s', 0.0) * 1e3:12.2f} "
+                      f"{r.get('batches', 0):8d}")
+        for r in preds:
+            print(f"   replicas_needed(req_s={r.get('req_s', 0.0):.0f}, "
+                  f"p99<={r.get('p99_target_s', 0.0) * 1e3:.1f} ms) = "
+                  f"{r.get('replicas_needed', '?')} "
+                  f"(observed {r.get('observed_replicas', '?')}, "
+                  f"predicted p99 {r.get('predicted_p99_s', 0.0) * 1e3:.1f} ms, "
+                  f"rho {r.get('rho', 0.0):.2f}, "
+                  f"confidence {r.get('confidence', '?')})")
 
     span_recs = by_kind.get("span", [])
     if span_recs:

@@ -1,41 +1,35 @@
 #!/usr/bin/env python
-"""Multi-tenant load generator for the serve v2 gateway.
+"""Multi-tenant load generator for the serve v2 gateway — thin CLI.
 
 Usage: python scripts/serve_loadgen.py [--requests 10000] [--tenants 4]
            [--replicas 2] [--batch 16] [--linger-ms 4] [--out loadgen.jsonl]
+           [--scenario <name>]
 
-Drives a mixed-shape, mixed-op request stream from ``--tenants`` asyncio
+Without ``--scenario`` this is the original closed-loop acceptance run:
+a mixed-shape, mixed-op request stream from ``--tenants`` asyncio
 submitters through the PRODUCTION serving path — :class:`Gateway`
 admission (token-bucket quota, weighted-fair lanes, deadline eviction),
 continuous batching, :class:`Router` placement across ``--replicas``
-pools on the host CPU mesh — and checks the run's SLOs:
+pools on the host CPU mesh — with the run's SLO checks (typed-shed
+accounting, batch fill >= 0.5, per-tenant percentiles, and with
+``--trace-out`` the span-chain integrity checks).  CI runs the
+500-request flavour as the serve-loadgen lane; the 10k default is the
+acceptance run.
 
-* every request resolves: OK results plus TYPED sheds
-  (``TenantQuotaExceededError`` / ``QueueFullError`` /
-  ``DeadlineExceededError``) must account for the full stream, with zero
-  unhandled errors;
-* the continuous batcher keeps the mean batch-fill ratio >= 0.5;
-* per-tenant p50/p95/p99 land in the ``--out`` JSONL (``gw_done`` +
-  ``gw_slo`` events) for ``scripts/report_metrics.py``;
-* with ``--trace-out trace.json``, request-scoped span tracing is enabled
-  for the run and the merged span records are exported as Chrome-trace/
-  Perfetto JSON (load in chrome://tracing or ui.perfetto.dev), with two
-  extra SLO checks: >= 95%% of completed requests carry the full span
-  chain (submit -> queue -> batch -> dispatch -> solve) and their summed
-  child durations land within 10%% of the recorded request latency.
+With ``--scenario <name>`` it executes a declarative scenario from the
+``dlaf_tpu.scenario`` library instead (open-loop arrival curves,
+adversarial tenants, fault timelines) and that scenario's own SLO block
+decides pass/fail — ``python -m dlaf_tpu.scenario list`` shows the
+library.  The loadgen core lives in ``dlaf_tpu/scenario/runner.py``;
+this script only parses arguments and forces the CPU mesh.
 
-CI runs the 500-request flavour as the serve-loadgen lane; the 10k
-default is the acceptance run.  Exit is nonzero if any check fails.
+Exit is nonzero if any check fails.
 """
 from __future__ import annotations
 
 import argparse
-import asyncio
-import json
 import os
 import sys
-import time
-from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -44,108 +38,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
-import numpy as np
-
-from dlaf_tpu import serve, tune
-from dlaf_tpu.health import (
-    DeadlineExceededError,
-    DeviceUnresponsiveError,
-    QueueFullError,
-    TenantQuotaExceededError,
-)
-from dlaf_tpu.obs import export as oexport
-from dlaf_tpu.obs import metrics as om
-from dlaf_tpu.obs import spans as ospans
-from dlaf_tpu.testing import random_hermitian_pd, random_matrix
-
-
-def tenant_roster(count: int) -> list:
-    """``count`` tenants with deliberately unequal contracts: an
-    interactive lane-0 tenant, weighted bulk tenants, and one
-    quota-limited tenant whose overage is expected to shed."""
-    roster = [
-        serve.TenantConfig("interactive", lane=0, weight=2.0, max_pending=128),
-        serve.TenantConfig("batch", lane=1, weight=2.0, max_pending=256),
-        serve.TenantConfig("bulk", lane=1, weight=0.5, max_pending=256),
-        serve.TenantConfig("limited", lane=1, weight=1.0, rate=400.0, burst=64,
-                           max_pending=256),
-    ]
-    for i in range(4, count):
-        roster.append(serve.TenantConfig(f"tenant{i}", lane=1, weight=1.0,
-                                         max_pending=256))
-    return roster[:max(count, 1)]
-
-
-def request_plan(n_requests: int, tenants: list, seed: int) -> list:
-    """Deterministic mixed stream: (tenant, kind, n, variant, deadline_s).
-
-    Shapes straddle the three buckets (under-sized requests exercise
-    padding); posv carries one RHS so it groups with its shape peers;
-    eigh stays a small fraction pinned to n=16 (it groups by exact
-    order).  ~1%% of requests carry an already-expired deadline to
-    exercise the gateway's deadline eviction path."""
-    rng = np.random.default_rng(seed)
-    names = [t.name for t in tenants]
-    plan = []
-    for i in range(n_requests):
-        tenant = names[int(rng.integers(len(names)))]
-        roll = rng.random()
-        if roll < 0.10:
-            kind, n = "eigh", 16
-        elif roll < 0.55:
-            kind = "potrf"
-            n = int(rng.choice((12, 16, 24, 32, 40, 48)))
-        else:
-            kind = "posv"
-            n = int(rng.choice((12, 16, 24, 32, 40, 48)))
-        deadline = 0.0 if rng.random() < 0.01 else None
-        plan.append((tenant, kind, n, int(rng.integers(4)), deadline))
-    return plan
-
-
-def problem_bank() -> dict:
-    """A small reusable bank of SPD matrices + RHS per (n, variant)."""
-    bank = {}
-    for n in (12, 16, 24, 32, 40, 48):
-        for v in range(4):
-            a = random_hermitian_pd(n, np.float32, seed=1000 * n + v)
-            b = random_matrix(n, 1, np.float32, seed=2000 * n + v)
-            bank[(n, v)] = (a, b)
-    return bank
-
-
-async def drive(gw, plan, bank, outstanding: int) -> dict:
-    sems = {t: asyncio.Semaphore(outstanding) for t in gw.tenants}
-    counts = {"ok": 0, "solver_info": 0, "shed_quota": 0, "shed_full": 0,
-              "deadline": 0, "failover_shed": 0, "unexpected": 0}
-
-    async def one(tenant, kind, n, variant, deadline):
-        a, b = bank[(n, variant)]
-        async with sems[tenant]:
-            try:
-                res = await gw.submit(tenant, kind, "L", a,
-                                      b if kind == "posv" else None,
-                                      deadline_s=deadline)
-                counts["ok" if res.info == 0 else "solver_info"] += 1
-            except TenantQuotaExceededError:
-                counts["shed_quota"] += 1
-            except QueueFullError:
-                counts["shed_full"] += 1
-            except DeadlineExceededError:
-                counts["deadline"] += 1
-            except DeviceUnresponsiveError:
-                counts["failover_shed"] += 1
-            except Exception as exc:  # noqa: BLE001 - the thing we're counting
-                counts["unexpected"] += 1
-                print(f"UNEXPECTED {type(exc).__name__}: {exc}")
-
-    await asyncio.gather(*(one(*req) for req in plan))
-    return counts
-
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--requests", type=int, default=10_000)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="request count (default: 10000, or the scenario's "
+                         "own count with --scenario)")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--batch", type=int, default=8)
@@ -161,112 +59,27 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None,
                     help="also enable span tracing and write the run's "
                          "Chrome-trace/Perfetto JSON here")
+    ap.add_argument("--scenario", default=None,
+                    help="run a named scenario from the dlaf_tpu.scenario "
+                         "library instead of the closed-loop stream")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="(scenario mode) compress (<1) or stretch (>1) the "
+                         "arrival + fault timeline")
     args = ap.parse_args(argv)
 
-    om.enable(args.out)
-    if args.trace_out:
-        ospans.enable()
-    om.emit_run_meta("serve_loadgen")
-    tune.initialize(serve_buckets="16,32,48")
+    from dlaf_tpu import scenario
+    from dlaf_tpu.scenario import runner
 
-    tenants = tenant_roster(args.tenants)
-    plan = request_plan(args.requests, tenants, args.seed)
-    bank = problem_bank()
-    failures = []
+    if args.scenario:
+        result = runner.run_scenario(
+            scenario.get(args.scenario), requests=args.requests,
+            out=args.out, trace_out=args.trace_out,
+            time_scale=args.time_scale)
+        return 0 if result.passed else 1
 
-    def expect(cond, what):
-        print(("ok  " if cond else "FAIL") + f"  {what}")
-        if not cond:
-            failures.append(what)
-
-    pools = [serve.SolverPool(block_size=8, max_batch=args.batch)
-             for _ in range(max(args.replicas, 1))]
-    router = serve.Router([serve.Replica(f"replica{i}", p)
-                           for i, p in enumerate(pools)])
-    t0 = time.monotonic()
-    try:
-        gw = serve.Gateway(router, tenants, max_batch=args.batch,
-                           linger_ms=args.linger_ms)
-        counts = asyncio.run(drive(gw, plan, bank, args.outstanding))
-        st = gw.stats()
-        gw.close()
-    finally:
-        router.close()
-    elapsed = time.monotonic() - t0
-    ospans.disable()
-    om.close()
-
-    total = sum(counts.values())
-    print(f"\n== serve_loadgen: {total} requests, {len(tenants)} tenants, "
-          f"{len(pools)} replicas, {elapsed:.1f}s "
-          f"({total / elapsed:.0f} req/s)")
-    print("   outcomes: " + "  ".join(f"{k}={v}" for k, v in counts.items() if v))
-    print(f"   batches: {st['batches']}  dispatched: {st['dispatched']}  "
-          f"mean fill: {st['batch_fill']:.2f}")
-    print(f"   {'tenant':>12s} {'admitted':>9s} {'ok':>7s} {'shed':>6s} "
-          f"{'evict':>6s} {'p50 ms':>8s} {'p95 ms':>8s} {'p99 ms':>8s}")
-    for name, t in sorted(st["tenants"].items()):
-        shed = t["shed_quota"] + t["shed_full"]
-        evict = t["evict_deadline"] + t["evict_priority"]
-        print(f"   {name:>12s} {t['admitted']:9d} {t['done_ok']:7d} {shed:6d} "
-              f"{evict:6d} {t['p50_s'] * 1e3:8.1f} {t['p95_s'] * 1e3:8.1f} "
-              f"{t['p99_s'] * 1e3:8.1f}")
-
-    expect(total == args.requests, f"all {args.requests} requests accounted for")
-    expect(counts["unexpected"] == 0,
-           f"zero unhandled errors (got {counts['unexpected']})")
-    expect(counts["ok"] >= 0.8 * args.requests,
-           f"the bulk of the stream completed OK ({counts['ok']}/{args.requests})")
-    expect(st["batch_fill"] >= 0.5,
-           f"continuous batching fill ratio >= 0.5 (got {st['batch_fill']:.2f})")
-    recs = [r for r in om.read_jsonl(args.out) if r["kind"] == "serve"]
-    slo = [r for r in recs if r["event"] == "gw_slo"]
-    expect(len(slo) == len(tenants),
-           f"per-tenant gw_slo roll-up in {args.out} ({len(slo)} records)")
-    expect(all(r["p50_s"] <= r["p95_s"] <= r["p99_s"]
-               for r in slo if r["done_ok"]),
-           "latency percentiles ordered per tenant")
-    done = [r for r in recs if r["event"] == "gw_done"]
-    expect(len(done) == total, f"gw_done per request in the stream ({len(done)})")
-
-    if args.trace_out:
-        allrecs = om.read_jsonl(args.out)
-        sp = [r for r in allrecs if r["kind"] == "span"]
-        doc = oexport.to_chrome_trace(allrecs)
-        with open(args.trace_out, "w") as fh:
-            json.dump(doc, fh)
-            fh.write("\n")
-        roots = [r for r in sp
-                 if r["name"] == "gw.request" and r.get("outcome") == "ok"]
-        kids = defaultdict(list)
-        for r in sp:
-            if r.get("parent_id") is not None:
-                kids[r["parent_id"]].append(r)
-        chain = {"gw.queue", "gw.batch", "gw.dispatch", "pool.queue", "serve.solve"}
-        full = tight = 0
-        for r in roots:
-            ch = kids.get(r["span_id"], [])
-            if chain <= {c["name"] for c in ch}:
-                full += 1
-            csum = sum(c["dur_s"] for c in ch)
-            if abs(csum - r["dur_s"]) <= 0.10 * max(r["dur_s"], 1e-9):
-                tight += 1
-        nr = len(roots)
-        n_ok = counts["ok"] + counts["solver_info"]
-        print(f"   trace: {len(sp)} spans, {nr} completed request roots "
-              f"-> {args.trace_out} ({len(doc['traceEvents'])} events)")
-        expect(nr == n_ok,
-               f"span root per completed request ({nr}/{n_ok})")
-        expect(nr > 0 and full >= 0.95 * nr,
-               f"full submit->queue->batch->dispatch->solve chain on >= 95% "
-               f"of completed requests ({full}/{nr})")
-        expect(nr > 0 and tight >= 0.95 * nr,
-               f"summed child durations within 10% of request latency on "
-               f">= 95% of completed requests ({tight}/{nr})")
-
-    print(("PASS" if not failures else "FAIL")
-          + f"  serve_loadgen ({len(recs)} serve events)")
-    return 1 if failures else 0
+    if args.requests is None:
+        args.requests = 10_000
+    return runner.run_loadgen(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,381 @@
+"""Scenario engine — specs, arrivals, chaos, replay, capacity (ISSUE 11).
+
+Covers the four scenario pillars bottom-up: declarative specs round-trip
+through their JSON form bit-for-bit and arrival-curve generation is a
+pure function of the seed; the open-loop runner executes a
+``replica_down`` failure storm through the REAL router drain/adopt path
+losing zero admitted requests; trace replay re-drives a captured span
+JSONL through a fresh gateway and reproduces the source run's admission
+outcome classes and batch group keys exactly; and the capacity model is
+monotone (more load never predicts fewer replicas) and lands within one
+replica of a synthetic run whose queueing behaviour it was fitted on.
+Satellites ride along: the ``replica_down`` fault context manager, the
+flight-recorder dump retention cap, and the scenario/seed stamping that
+makes every artifact self-identifying.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dlaf_tpu import scenario, serve
+from dlaf_tpu.health import ConfigurationError, DeviceUnresponsiveError
+from dlaf_tpu.obs import flight, metrics as om
+from dlaf_tpu.scenario import capacity as scap
+from dlaf_tpu.scenario import replay as sreplay
+from dlaf_tpu.scenario import runner, spec
+from dlaf_tpu.testing import faults
+
+
+# ------------------------------------------------------------------- specs
+
+
+def test_library_round_trips_through_json():
+    for name in scenario.names():
+        s = scenario.get(name)
+        wire = json.loads(json.dumps(s.to_dict()))
+        assert spec.Scenario.from_dict(wire) == s, name
+
+
+def test_spec_validation_rejects_bad_configs():
+    with pytest.raises(ConfigurationError):
+        spec.ArrivalCurve(shape="sawtooth")
+    with pytest.raises(ConfigurationError):
+        spec.ArrivalCurve(rate=0.0)
+    with pytest.raises(ConfigurationError):
+        spec.TenantSpec("t", adversarial="ddos")
+    with pytest.raises(ConfigurationError):
+        spec.FaultEvent(at_s=1.0, kind="replica_down", target=None)
+    with pytest.raises(ConfigurationError):
+        spec.Scenario("dup", tenants=(spec.TenantSpec("a"), spec.TenantSpec("a")))
+    with pytest.raises(ConfigurationError):
+        # fault targets a replica the scenario does not have
+        spec.Scenario("bad", replicas=1,
+                      faults=(spec.FaultEvent(at_s=1.0, target="replica7"),))
+    with pytest.raises(ConfigurationError):
+        scenario.get("no_such_scenario")
+
+
+def test_arrival_curves_are_seed_deterministic():
+    for shape, kw in (("constant", {}),
+                      ("diurnal", {"period_s": 4.0, "amplitude": 0.9}),
+                      ("burst", {"period_s": 2.0, "burst_factor": 6.0})):
+        curve = spec.ArrivalCurve(shape, rate=40.0, **kw)
+        a = curve.offsets(200, np.random.default_rng(7))
+        b = curve.offsets(200, np.random.default_rng(7))
+        assert a == b, shape
+        c = curve.offsets(200, np.random.default_rng(8))
+        assert a != c, shape
+        assert all(x < y for x, y in zip(a, a[1:])), shape
+
+
+def test_burst_curve_actually_bursts():
+    curve = spec.ArrivalCurve("burst", rate=10.0, period_s=4.0, duty=0.25,
+                              burst_factor=8.0)
+    offs = curve.offsets(2000, np.random.default_rng(0))
+    in_burst = sum(1 for t in offs if (t % 4.0) < 1.0)
+    # 8x rate over 25% of the period: the burst window should hold the
+    # majority of arrivals (8 / (8*0.25 + 1*0.75) ~ 73% expected)
+    assert in_burst > len(offs) * 0.6
+
+
+def test_build_schedule_deterministic_and_apportioned():
+    s = scenario.get("burst")
+    sch = runner.build_schedule(s, 120)
+    assert sch == runner.build_schedule(s, 120)
+    assert len(sch) == 120
+    per_tenant = {t.name: 0 for t in s.tenants}
+    for arr in sch:
+        per_tenant[arr.tenant] += 1
+    assert per_tenant == {"steady": 60, "bursty": 60}
+    assert all(x.at_s <= y.at_s for x, y in zip(sch, sch[1:]))
+
+
+def test_deadline_edge_tenant_draws_from_ladder():
+    s = scenario.get("adversarial")
+    sch = runner.build_schedule(s, 200)
+    probes = [a for a in sch if a.tenant == "deadline_prober"]
+    assert probes
+    assert {a.deadline_s for a in probes} <= set(spec.DEADLINE_EDGE_LADDER)
+
+
+# ------------------------------------------------------- replica_down fault
+
+
+def test_replica_down_forces_probe_failure_and_recovers():
+    pools = [serve.SolverPool(max_batch=4) for _ in range(2)]
+    router = serve.Router([serve.Replica(f"replica{i}", p)
+                           for i, p in enumerate(pools)])
+    try:
+        rep = router.get("replica0")
+        orig_probe = rep.watchdog.probe
+        with faults.replica_down(router, "replica0"):
+            with pytest.raises(DeviceUnresponsiveError):
+                rep.watchdog.probe(0.1)
+            summary = router.check()
+            assert "replica0" in summary["down"]
+            assert not rep.healthy
+        # CM exit removes the patch; attribute lookup finds the real
+        # method again (== compares the underlying function + receiver)
+        assert rep.watchdog.probe == orig_probe
+        assert "probe" not in rep.watchdog.__dict__
+        router.check()
+        assert rep.healthy
+    finally:
+        for p in pools:
+            p.close()
+
+
+def test_replica_down_transient_recovers_inside_block():
+    pools = [serve.SolverPool(max_batch=4) for _ in range(2)]
+    router = serve.Router([serve.Replica(f"replica{i}", p)
+                           for i, p in enumerate(pools)])
+    try:
+        rep = router.get("replica0")
+        # pre-warm the probe kernel while healthy so the timed window
+        # below is not eaten by the first probe's compile
+        rep.watchdog.probe()
+        with faults.replica_down(router, "replica0", seconds=0.2):
+            with pytest.raises(DeviceUnresponsiveError):
+                rep.watchdog.probe(0.1)
+            time.sleep(0.25)
+            rep.watchdog.probe(5.0)  # healed mid-block: no raise
+    finally:
+        for p in pools:
+            p.close()
+
+
+# --------------------------------------------------- storm scenario (chaos)
+
+
+def _storm_spec(requests=50):
+    return spec.Scenario(
+        "storm_test", seed=5, requests=requests,
+        tenants=(spec.TenantSpec(
+            "steady", share=1.0, max_pending=512, expired_frac=0.0,
+            arrival=spec.ArrivalCurve("constant", rate=60.0)),),
+        mix=spec.OpMix(shapes=(16,), eigh=0.0),
+        faults=(spec.FaultEvent(at_s=0.3, kind="replica_down", seconds=0.6,
+                                target="replica0"),),
+        slo=spec.SLO(min_ok_frac=0.8, zero_lost_admitted=True),
+        replicas=2, buckets="16")
+
+
+def test_replica_storm_loses_zero_admitted_requests(tmp_path):
+    out = str(tmp_path / "storm.jsonl")
+    res = runner.run_scenario(_storm_spec(), out=out, quiet=True)
+    assert res.passed, res.failures
+    # every admitted request resolved: results or typed sheds, no drops
+    for name, t in res.stats["tenants"].items():
+        assert t["pending"] == 0, name
+        assert t["admitted"] == t["done_ok"] + t["done_err"], name
+    assert res.counts["unexpected"] == 0
+    assert sum(res.counts.values()) == res.requests
+    # the REAL failover path ran: down + drain + revive events in the log
+    ev = {r["event"] for r in om.read_jsonl(out)
+          if r["kind"] == "serve" and r["event"].startswith("replica_")}
+    assert {"replica_down", "replica_up"} <= ev
+    # scenario result record rides the same stream, self-identified
+    (meta,) = [r for r in om.read_jsonl(out) if r["kind"] == "run_meta"]
+    assert meta["scenario"] == "storm_test" and meta["seed"] == 5
+    (result,) = [r for r in om.read_jsonl(out) if r["kind"] == "scenario"]
+    assert result["event"] == "result" and result["passed"]
+
+
+# ----------------------------------------------------------------- replay
+
+
+def _small_capture(tmp_path):
+    out = str(tmp_path / "capture.jsonl")
+    s = spec.Scenario(
+        "replay_src", seed=3, requests=40,
+        tenants=(
+            spec.TenantSpec("a", share=0.5, expired_frac=0.15,
+                            arrival=spec.ArrivalCurve("constant", rate=150.0)),
+            spec.TenantSpec("b", share=0.5,
+                            arrival=spec.ArrivalCurve("burst", rate=80.0,
+                                                      period_s=0.5)),
+        ),
+        mix=spec.OpMix(shapes=(16,), eigh=0.0),
+        slo=spec.SLO(min_ok_frac=0.5),
+        replicas=1, buckets="16")
+    res = runner.run_scenario(s, out=out, trace_out=str(tmp_path / "t.json"),
+                              quiet=True)
+    assert res.passed, res.failures
+    return out, res
+
+
+def test_replay_reproduces_outcomes_and_group_keys(tmp_path):
+    out, res = _small_capture(tmp_path)
+    items, meta = sreplay.load_schedule(om.read_jsonl(out))
+    # only admitted requests carry roots; this capture sheds nothing
+    # (the extra roots are the warmup pass, one per distinct (kind, n))
+    timeline = [it for it in items if it.tenant != runner.WARMUP_TENANT]
+    assert len(timeline) == res.requests
+    assert all(it.cls == "ok" for it in items if
+               it.tenant == runner.WARMUP_TENANT)
+    assert meta["scenario"] == "replay_src" and meta["buckets"] == "16"
+    bank = sreplay._operand_bank(items)
+    assert sreplay.check_group_keys(items, bank, buckets=meta["buckets"]) == []
+    replayed = sreplay.run_replay(items, meta, time_scale=0.25)
+    report = sreplay.compare(items, replayed)
+    assert report["mismatches"] == []
+    # bit-for-bit: the per-request class sequence matches, not just tallies
+    assert [it.cls for it in items] == replayed
+    assert {it.cls for it in items} == {"ok", "deadline"}
+
+
+def test_replay_rejects_pre_v3_traces(tmp_path):
+    rec = {"kind": "span", "name": "gw.request", "t0_s": 0.0, "dur_s": 0.1,
+           "trace_id": "t", "span_id": "s", "tenant": "a", "op": "potrf"}
+    with pytest.raises(ConfigurationError):
+        sreplay.load_schedule([rec])
+
+
+def test_replay_cli_asserts_match(tmp_path):
+    out, _ = _small_capture(tmp_path)
+    rout = str(tmp_path / "replay.jsonl")
+    rc = sreplay.main([out, "--out", rout, "--assert-match",
+                       "--time-scale", "0.25"])
+    assert rc == 0
+    (rec,) = [r for r in om.read_jsonl(rout) if r["kind"] == "scenario"]
+    assert rec["event"] == "replay" and rec["matched"]
+    assert rec["outcome_mismatches"] == 0 and rec["group_mismatches"] == 0
+
+
+# --------------------------------------------------------------- capacity
+
+
+def _synth_run(name, req_s, replicas, p99_s, n_done=400, *,
+               per_batch_a=0.002, per_batch_b=0.004, batch=4):
+    """A synthetic record stream with exactly the events the capacity
+    model consumes, shaped like a steady run at ``req_s``."""
+    recs = [{"kind": "run_meta", "name": name, "replicas": replicas,
+             "linger_ms": 5.0}]
+    span = n_done / req_s
+    for i in range(n_done):
+        recs.append({"kind": "serve", "event": "request_done", "op": "potrf",
+                     "bucket": "16", "ts": 100.0 + span * i / n_done,
+                     "queue_s": 0.01, "info": 0})
+    for i in range(n_done // batch):
+        recs.append({"kind": "serve", "event": "batch", "op": "potrf",
+                     "bucket": "16", "batch": batch,
+                     "seconds": per_batch_a + per_batch_b * batch,
+                     "ts": 100.0 + i})
+    recs.append({"kind": "serve", "event": "gw_slo", "tenant": "t",
+                 "done_ok": n_done, "p99_s": p99_s, "ts": 100.0 + span})
+    return recs
+
+
+def test_capacity_fit_recovers_service_time():
+    model = scap.CapacityModel.fit_records(
+        [_synth_run("r1", 50.0, 2, 0.030),
+         _synth_run("r2", 100.0, 2, 0.040)],
+        names=["r1", "r2"])
+    fit = model.fits[("potrf", 16)]
+    # per-request mean at batch=4: (0.002 + 0.004*4)/4 = 0.0045
+    assert fit.per_req_s == pytest.approx(0.0045, rel=1e-6)
+
+
+def test_capacity_model_is_monotone_in_load_and_replicas():
+    model = scap.CapacityModel.fit_records(
+        [_synth_run("r1", 50.0, 2, 0.030),
+         _synth_run("r2", 100.0, 2, 0.040)],
+        names=["r1", "r2"])
+    mix = {("potrf", 16): 1.0}
+    # p99 estimate never improves when load grows at fixed replicas
+    p = [model.predict_p99(r, mix, 4) for r in (50, 100, 200, 400, 800)]
+    feasible = [x for x in p if x is not None]
+    assert feasible == sorted(feasible)
+    assert all(x is None for x in p[len(feasible):])  # divergence is terminal
+    # more load never needs fewer replicas
+    needed = [model.replicas_needed(r, mix, 0.050).replicas
+              for r in (20, 50, 100, 200, 400, 800)]
+    assert needed == sorted(needed)
+    # more replicas never hurts the p99 estimate
+    at_r = [model.predict_p99(400, mix, r) for r in (2, 4, 8, 16)]
+    assert all(x is not None for x in at_r[1:])
+    pairs = [(a, b) for a, b in zip(at_r, at_r[1:]) if a is not None]
+    assert all(a >= b for a, b in pairs)
+
+
+def _queue_p99(req_s, replicas, factor):
+    """Observed-p99 generator consistent with the model's queueing form
+    (service constants match ``_synth_run``'s defaults): ``factor`` is the
+    real-world inflation over the modeled base latency."""
+    per_req_s = 0.0045          # (0.002 + 0.004*4) / 4
+    dispatch_s = 0.018          # a + b degenerates to mean batch seconds
+    rho = req_s / replicas * per_req_s
+    return factor * (0.005 + dispatch_s + rho / (1.0 - rho) * per_req_s)
+
+
+def test_capacity_predicts_holdout_within_one_replica():
+    # Training runs inflate the modeled base by a consistent 2.0x; the
+    # holdout's observed p99 carries 5% extra slack so the calibrated
+    # prediction can meet it at the holdout's own replica count.
+    model = scap.CapacityModel.fit_records(
+        [_synth_run("r1", 60.0, 2, _queue_p99(60.0, 2, 2.0)),
+         _synth_run("r2", 120.0, 2, _queue_p99(120.0, 2, 2.0))],
+        names=["r1", "r2"])
+    holdout = scap._extract_run(
+        _synth_run("h", 90.0, 2, _queue_p99(90.0, 2, 2.1)), "h")
+    pred = model.replicas_needed(holdout.req_s, holdout.mix, holdout.p99_s,
+                                 linger_s=holdout.linger_s)
+    assert pred.feasible
+    assert abs(pred.replicas - holdout.replicas) <= 1
+    assert pred.confidence in ("high", "medium", "low")
+
+
+def test_capacity_needs_data():
+    with pytest.raises(ConfigurationError):
+        scap.CapacityModel.fit_records([[{"kind": "note", "text": "empty"}]])
+
+
+# ----------------------------------------------------- flight dump retention
+
+
+def test_flight_dump_retention_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.MAX_DUMPS_ENV, "3")
+    flight.enable(capacity=16, dump_dir=str(tmp_path))
+    try:
+        paths = [flight.dump(f"reason{i}") for i in range(6)]
+    finally:
+        flight.disable()
+    kept = sorted(f for f in os.listdir(tmp_path)
+                  if f.startswith("flight_") and f.endswith(".json"))
+    assert len(kept) == 3
+    # the newest dump always survives the prune
+    assert os.path.basename(paths[-1]) in kept
+
+
+def test_flight_dump_cap_disabled_keeps_all(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.MAX_DUMPS_ENV, "0")
+    flight.enable(capacity=16, dump_dir=str(tmp_path))
+    try:
+        for i in range(5):
+            flight.dump(f"r{i}")
+    finally:
+        flight.disable()
+    kept = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+    assert len(kept) == 5
+
+
+# -------------------------------------------------- self-identifying header
+
+
+def test_report_header_prints_scenario_and_seed(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import report_metrics
+
+    path = str(tmp_path / "m.jsonl")
+    om.enable(path)
+    om.emit_run_meta("scenario", scenario="burst", seed=7, requests=500,
+                     replicas=2)
+    om.close()
+    assert report_metrics.summarize(path) == 0
+    out = capsys.readouterr().out
+    assert "scenario=burst" in out and "seed=7" in out and "replicas=2" in out

@@ -110,7 +110,7 @@ def test_nested_spans_share_trace_and_chain_parents(tmp_path):
     by_name = {r["name"]: r for r in _spans_of(path)}
     assert set(by_name) == {"root", "mid", "leaf"}
     root, mid, leaf = by_name["root"], by_name["mid"], by_name["leaf"]
-    assert root["schema"] == "dlaf_tpu.obs/2"
+    assert root["schema"] == om.SCHEMA
     assert "parent_id" not in root and root["tenant"] == "t0"
     assert mid["parent_id"] == root["span_id"]
     assert leaf["parent_id"] == mid["span_id"]
@@ -216,12 +216,12 @@ def test_request_handle_marks_tile_the_interval(tmp_path):
 # ------------------------------------------------------------- schema
 
 
-def test_schema_v1_and_v2_both_validate():
+def test_schema_all_versions_validate():
     base = {"ts": time.time(), "rank": 0, "kind": "note", "text": "x"}
-    om.validate_record({"schema": "dlaf_tpu.obs/1", **base})
-    om.validate_record({"schema": "dlaf_tpu.obs/2", **base})
+    for tag in om.SCHEMAS:
+        om.validate_record({"schema": tag, **base})
     with pytest.raises(ValueError, match="bad schema tag"):
-        om.validate_record({"schema": "dlaf_tpu.obs/3", **base})
+        om.validate_record({"schema": "dlaf_tpu.obs/4", **base})
     om.validate_record({
         "schema": "dlaf_tpu.obs/2", "ts": 0.0, "rank": 0, "kind": "span",
         "name": "x", "trace_id": "t", "span_id": "s", "t0_s": 0.0, "dur_s": 0.1,
@@ -242,13 +242,13 @@ def test_read_jsonl_accepts_v1_files(tmp_path):
     assert rec["text"] == "old artifact"
 
 
-def test_emitter_stamps_v2(tmp_path):
+def test_emitter_stamps_current_schema(tmp_path):
     path = str(tmp_path / "m.jsonl")
     om.enable(path)
     om.emit("note", text="x")
     om.close()
     (rec,) = om.read_jsonl(path)
-    assert rec["schema"] == "dlaf_tpu.obs/2"
+    assert rec["schema"] == om.SCHEMA
 
 
 # ------------------------------------------------------- emit thread-safety
@@ -611,7 +611,7 @@ def test_report_metrics_prints_schema_and_span_rollup(tmp_path, capsys):
     om.close()
     assert report_metrics.summarize(path) == 0
     out = capsys.readouterr().out
-    assert "dlaf_tpu.obs/2" in out  # satellite: schema version printed
+    assert om.SCHEMA in out  # satellite: schema version printed
     assert "-- spans" in out and "gw.request" in out
     assert "request breakdown" in out and "per-tenant critical path" in out
     assert "alice" in out
