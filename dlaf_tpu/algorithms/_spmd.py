@@ -90,18 +90,6 @@ def gemm_precision_trace_key() -> str:
     return resolved_gemm_precision()
 
 
-def serve_trace_key():
-    """The active serve-bucket token (None outside ``dlaf_tpu.serve``) —
-    same discipline as :func:`trsm_trace_key`: compilations triggered on
-    behalf of a serve bucket carry the bucket identity in the kernel
-    compile-cache keys, so an evicted-and-rebuilt bucket can never alias a
-    kernel traced for a different one.  Lazy import: serve is an optional
-    L7 layer and the kernels must not pull it in at import time."""
-    from dlaf_tpu.serve.context import serve_trace_key as _key
-
-    return _key()
-
-
 def halving_segments(n: int, ratio: float | None = None):
     """Panel-index segments [k0, k1) whose trailing extent shrinks by
     ``ratio`` per segment, so each segment runs with one static

@@ -33,8 +33,6 @@ import numpy as np
 
 _K_ROUND = 32  # chase-unit bucket granularity (bounds compile count)
 
-_kern_cache: dict = {}
-
 
 def _units(n: int, b: int, s: int) -> int:
     """Chase units (== reflector count) of sweep s: (n-3-s)//b + 1
@@ -220,19 +218,22 @@ def device_chase_hh(
             # bucket K so consecutive blocks share the compiled kernel
             K = int(min(-(-int(counts.max()) // _K_ROUND) * _K_ROUND, K_full))
             t_max = int(3 * (min(s1 - s0, SB) - 1) + counts.max())
-            key = (dt, b, SB, K, n_pad, prec)
-            if key not in _kern_cache:
-                _kern_cache[key] = jax.jit(
+            from dlaf_tpu.plan import core as _plan
+
+            kern = _plan.cached(
+                "band_chase", (dt, b, SB, K, n, n_pad, prec),
+                lambda: jax.jit(
                     partial(
                         _chase_block_kernel, n=n, n_pad=n_pad, b=b, SB=SB, K=K
                     ),
                     donate_argnums=(0, 1, 2, 3, 4),
-                )
+                ),
+            )
             vcur = jnp.zeros((SB, b), dt)
             taucur = jnp.zeros((SB,), dt)
             v_out = jnp.zeros((SB, K, b), dt)
             tau_out = jnp.zeros((SB, K), dt)
-            ab, _, _, v_out, tau_out = _kern_cache[key](
+            ab, _, _, v_out, tau_out = kern(
                 ab, vcur, taucur, v_out, tau_out,
                 jnp.asarray(s0, jnp.int32), jnp.asarray(counts), jnp.asarray(t_max, jnp.int32),
             )

@@ -158,9 +158,6 @@ def _sbr_chunk_kernel(
     return lax.fori_loop(0, CH, sweep_body, (ab, qstack))
 
 
-_kern_cache = {}
-
-
 def sbr_reduce(ab_host: np.ndarray, b1: int, b2: int, want_q: bool = True):
     """Reduce the compact lower-band matrix ``ab_host`` ([>= b1+1, n] with
     ab[d, j] = A[j+d, j]) from band b1 to band b2 on device.
@@ -195,17 +192,21 @@ def sbr_reduce(ab_host: np.ndarray, b1: int, b2: int, want_q: bool = True):
     with matmul_precision(prec):
         for (s0, s1, K) in chunks:
             CH = s1 - s0
-            key = (np.dtype(dt), b1, b2, n_pad, CH, K, prec, want_q)
-            if key not in _kern_cache:
-                kern = partial(
-                    _sbr_chunk_kernel, b1=b1, b2=b2, CH=CH, K=K, want_q=want_q
-                )
-                _kern_cache[key] = jax.jit(kern, donate_argnums=(0, 1))
+            from dlaf_tpu.plan import core as _plan
+
+            kern = _plan.cached(
+                "sbr_chunk", (np.dtype(dt), b1, b2, n_pad, CH, K, prec, want_q),
+                lambda: jax.jit(
+                    partial(_sbr_chunk_kernel, b1=b1, b2=b2, CH=CH, K=K,
+                            want_q=want_q),
+                    donate_argnums=(0, 1),
+                ),
+            )
             if want_q:
                 q0 = jnp.zeros((CH, K + 1, b1, b1), dt) + eye
             else:
                 q0 = jnp.zeros((0, 1, b1, b1), dt)
-            ab, qchunk = _kern_cache[key](ab, q0, jnp.asarray(s0))
+            ab, qchunk = kern(ab, q0, jnp.asarray(s0))
             if want_q:
                 # stage to host immediately: the device only ever holds
                 # one chunk of transform storage
@@ -237,9 +238,6 @@ def _bt_chunk_loop(e_pad, qchunk, s_base, *, b1: int, b2: int, CH: int):
         return lax.dynamic_update_slice(e, ew.reshape(span, kcols), (r0, z))
 
     return lax.fori_loop(0, CH, sweep_body, e_pad)
-
-
-_bt_cache = {}
 
 
 def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
@@ -306,19 +304,23 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
                 f"ColPanels kpad {e_cols.shape[1]} != expected {kpad}"
             )
         if e_cols.shape[0] < n_pad:
-            rp_key = ("rowpad", grid.cache_key, tuple(e_cols.shape), n_pad, dt)
-            if rp_key not in _bt_cache:
-                _bt_cache[rp_key] = jax.jit(
+            from dlaf_tpu.plan import core as _plan
+
+            rp = _plan.cached(
+                "sbr_bt_rowpad",
+                (grid.cache_key, tuple(e_cols.shape), n_pad, dt),
+                lambda: jax.jit(
                     lambda gp: jnp.pad(gp, ((0, n_pad - gp.shape[0]), (0, 0))),
                     out_shardings=col_sh,
-                )
-            e_cols = _bt_cache[rp_key](e_cols)
+                ),
+            )
+            e_cols = rp(e_cols)
         else:
             n_pad = int(e_cols.shape[0])
     else:
-        pre_key = ("pre", grid.cache_key, dist, n_pad, kpad, dt)
-        if pre_key not in _bt_cache:
+        from dlaf_tpu.plan import core as _plan
 
+        def build_pre():
             def pre(x):
                 gg = layout.unpad_global(layout.unpack(x, dist), dist)
                 gp = jnp.pad(gg, ((0, n_pad - n), (0, kpad - k)))
@@ -326,15 +328,19 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
 
             # no donation: the stacked input cannot alias the col-sharded
             # padded output (different shapes), donating only warns
-            _bt_cache[pre_key] = jax.jit(pre, out_shardings=col_sh)
-        e_cols = _bt_cache[pre_key](mat_e.data)
+            return jax.jit(pre, out_shardings=col_sh)
+
+        e_cols = _plan.cached(
+            "sbr_bt_pre", (grid.cache_key, dist, n_pad, kpad, dt), build_pre
+        )(mat_e.data)
     # all stacked exits pack through the one shared jit in colpanels
     with matmul_precision(prec):
         for (s0, q) in reversed(tr.chunks):
             CH = q.shape[0]
             K = q.shape[1] - 1
-            akey = ("apply", grid.cache_key, n_pad, kpad, b1, b2, CH, K, dt, prec)
-            if akey not in _bt_cache:
+            from dlaf_tpu.plan import core as _plan
+
+            def build_apply(CH=CH):
                 loop = partial(_bt_chunk_loop, b1=b1, b2=b2, CH=CH)
                 sm = coll.shard_map_compat(
                     lambda e, qc, sb: loop(e, qc, sb),
@@ -342,10 +348,14 @@ def sbr_back_transform(tr: SbrTransforms, mat_e, out_cols: bool = False):
                     in_specs=(colspec, P(), P()),
                     out_specs=colspec,
                 )
-                _bt_cache[akey] = jax.jit(
-                    sm, out_shardings=col_sh, donate_argnums=(0,)
-                )
-            e_cols = _bt_cache[akey](e_cols, jnp.asarray(q), jnp.asarray(s0))
+                return jax.jit(sm, out_shardings=col_sh, donate_argnums=(0,))
+
+            apply_fn = _plan.cached(
+                "sbr_bt_apply",
+                (grid.cache_key, n_pad, kpad, b1, b2, CH, K, dt, prec),
+                build_apply,
+            )
+            e_cols = apply_fn(e_cols, jnp.asarray(q), jnp.asarray(s0))
     if out_cols:
         return cpan.ColPanels(e_cols, n, k, grid, dist)
     out = cpan.pack_to_matrix(cpan.ColPanels(e_cols, n, k, grid, dist))

@@ -37,9 +37,6 @@ class BandToTridiagResult:
     phases: np.ndarray = None
 
 
-_band_gather_cache: dict = {}
-
-
 def _gather_band_tiles(mat: DistributedMatrix):
     """Fetch the diagonal and first-subdiagonal tiles to host in ONE jitted
     gather with replicated output — multi-process safe (``get_tile`` reads
@@ -48,9 +45,9 @@ def _gather_band_tiles(mat: DistributedMatrix):
     ``(diag [mt, mb, nb], sub [mt-1, mb, nb])`` (padded tile extents; the
     callers trim with ``tile_size_of``)."""
     dist, grid = mat.dist, mat.grid
-    key = (grid.cache_key, tuple(dist.size), tuple(dist.block_size),
-           tuple(dist.source_rank), str(np.dtype(mat.dtype)))
-    if key not in _band_gather_cache:
+    from dlaf_tpu.plan import core as _plan
+
+    def build():
         # the cache key fully determines these index arrays, so they are
         # built only alongside the jit that closes over them
         mt = dist.nr_tiles.rows
@@ -68,11 +65,18 @@ def _gather_band_tiles(mat: DistributedMatrix):
         import jax
 
         rep = grid.replicated_sharding()
-        _band_gather_cache[key] = jax.jit(
+        return jax.jit(
             lambda x: (x[idx["diag"]], x[idx["sub"]]),
             out_shardings=(rep, rep),
         )
-    diag, sub = _band_gather_cache[key](mat.data)
+
+    fn = _plan.cached(
+        "band_gather",
+        (grid.cache_key, tuple(dist.size), tuple(dist.block_size),
+         tuple(dist.source_rank), str(np.dtype(mat.dtype))),
+        build,
+    )
+    diag, sub = fn(mat.data)
     return np.asarray(diag), np.asarray(sub)
 
 

@@ -100,9 +100,6 @@ def _build_factors(v_refl, taus, groups, w, g, b, dtype):
     return V_all, tau_all, offs
 
 
-_apply_cache = {}
-
-
 def _wy_group_loop(e_pad, V_all, tau_all, offs, w, g, G, k):
     """Apply the G grouped compact-WY factors to the k-column block ``e_pad``
     (the shared core of the host-input and distributed back-transforms).
@@ -135,25 +132,27 @@ def _apply_fn(n_pad, k, w, g, G, dtype, dist_key=None, dist=None, sharding=None,
     """Jitted grouped-WY application (+ optional pack to stacked layout)."""
     import jax
 
-    key = (n_pad, k, w, g, G, np.dtype(dtype), dist_key, prec)
-    if key in _apply_cache:
-        return _apply_cache[key]
+    from dlaf_tpu.plan import core as _plan
 
-    from dlaf_tpu.matrix import layout
+    def build():
+        from dlaf_tpu.matrix import layout
 
-    def run(e_pad, V_all, tau_all, offs):
-        e_pad = _wy_group_loop(e_pad, V_all, tau_all, offs, w, g, G, k)
-        if dist is None:
-            return e_pad
-        eg = e_pad[: dist.size.rows, :]
-        return layout.pack(layout.pad_global(eg, dist), dist)
+        def run(e_pad, V_all, tau_all, offs):
+            e_pad = _wy_group_loop(e_pad, V_all, tau_all, offs, w, g, G, k)
+            if dist is None:
+                return e_pad
+            eg = e_pad[: dist.size.rows, :]
+            return layout.pack(layout.pad_global(eg, dist), dist)
 
-    fn = jax.jit(run, out_shardings=sharding) if sharding is not None else jax.jit(run)
-    _apply_cache[key] = fn
-    return fn
+        if sharding is not None:
+            return jax.jit(run, out_shardings=sharding)
+        return jax.jit(run)
 
-
-_dist_cache = {}
+    return _plan.cached(
+        "bt_band_apply",
+        (n_pad, k, w, g, G, np.dtype(dtype), dist_key, prec),
+        build,
+    )
 
 
 def bt_band_to_tridiagonal_hh_dist(
@@ -216,9 +215,9 @@ def bt_band_to_tridiagonal_hh_dist(
     if dt.kind == "c":
         ph[:n] = phases.astype(dt)
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (grid.cache_key, dist, n_pad, kpad, w, g, G, dt, prec, out_cols)
-    if key not in _dist_cache:
+    from dlaf_tpu.plan import core as _plan
 
+    def build():
         def loop(va, ta, of, e_loc):
             return _wy_group_loop(e_loc, va, ta, of, w, g, G, kloc)
 
@@ -244,11 +243,17 @@ def bt_band_to_tridiagonal_hh_dist(
         )
         # donation only helps when output aliases input (stacked -> stacked);
         # the col-sharded output can't alias, donating would only warn
-        _dist_cache[key] = jax.jit(
+        return jax.jit(
             run, out_shardings=out_sh, donate_argnums=() if out_cols else (0,)
         )
+
+    fn = _plan.cached(
+        "bt_band_dist",
+        (grid.cache_key, dist, n_pad, kpad, w, g, G, dt, prec, out_cols),
+        build,
+    )
     with matmul_precision(prec):
-        data = _dist_cache[key](
+        data = fn(
             mat_e.data,
             jnp.asarray(V_all),
             jnp.asarray(tau_all),

@@ -28,6 +28,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _panel_v_tmat(a, taus, p, g_a: _spmd.Geometry, band: int):
@@ -99,9 +100,6 @@ def _bt_r2b_cols_kernel(a, taus, e, g_a: _spmd.Geometry, n_panels: int, band: in
     return lax.fori_loop(0, n_panels, body, e)
 
 
-_cache = {}
-
-
 def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
     """ColPanels entry: consume the column-sharded E of the fused
     back-transform chain, apply Q1, and perform the chain's single final
@@ -127,12 +125,7 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
     mesh = grid.mesh
     colspec = P(None, (ROW_AXIS, COL_AXIS))
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (
-        "cols", grid.cache_key, g_a, dist, tuple(cols.data.shape),
-        n_panels, band, prec, np.dtype(cols.data.dtype),
-        coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(),
-    )
-    if key not in _cache:
+    def build():
 
         def kern(a, t, e):
             return _bt_r2b_cols_kernel(a, t, e, g_a=g_a, n_panels=n_panels, band=band)
@@ -157,9 +150,16 @@ def _bt_r2b_cols(cols, mat_band: DistributedMatrix, taus: jax.Array):
             return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
 
         # no donation: the col-sharded input cannot alias the stacked output
-        _cache[key] = jax.jit(run, out_shardings=grid.stacked_sharding())
+        return jax.jit(run, out_shardings=grid.stacked_sharding())
+
+    fn = _plan.cached(
+        "bt_r2b_cols",
+        (grid.cache_key, g_a, dist, tuple(cols.data.shape), n_panels, band,
+         prec, np.dtype(cols.data.dtype)),
+        build,
+    )
     with matmul_precision(prec):
-        data = _cache[key](mat_band.data, taus, cols.data)
+        data = fn(mat_band.data, taus, cols.data)
     return DistributedMatrix(dist, grid, data)
 
 
@@ -204,10 +204,12 @@ def bt_reduction_to_band(
     from dlaf_tpu.tune import get_tune_parameters, matmul_precision
 
     prec = get_tune_parameters().eigensolver_matmul_precision
-    key = (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec,
-           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
-    if key not in _cache:
+    def build():
         kern = partial(_bt_r2b_kernel, g_a=g_a, g_e=g_e, n_panels=n_panels, band=band)
-        _cache[key] = coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
+        return coll.spmd(mat_e.grid, kern, donate_argnums=(2,))
+
+    fn = _plan.cached(
+        "bt_r2b", (mat_e.grid.cache_key, g_a, g_e, n_panels, band, prec), build
+    )
     with matmul_precision(prec):
-        return mat_e._inplace(_cache[key](mat_band.data, taus_stacked, mat_e.data))
+        return mat_e._inplace(fn(mat_band.data, taus_stacked, mat_e.data))

@@ -46,6 +46,7 @@ from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.comms import record as _rec_comms
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _diag_potrf(d):
@@ -406,17 +407,9 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     return (coll.relocal(x), info) if want_info else coll.relocal(x)
 
 
-_kernel_cache = {}
-
-
 def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
               want_info: bool = False):
-    # only the bucketed variant bakes ratio-dependent segments
-    ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    key = (grid.cache_key, g, uplo, variant, ratio, _spmd.trsm_trace_key(),
-           coll.collectives_trace_key(), _spmd.serve_trace_key(),
-           _spmd.gemm_precision_trace_key(), want_info)
-    if key not in _kernel_cache:
+    def build():
         kern_fn = {
             "bucketed": _chol_L_bucketed_kernel,
             "masked": _chol_L_kernel,
@@ -426,20 +419,16 @@ def _compiled(grid, g: _spmd.Geometry, uplo: str, variant: str = "bucketed",
             # kernels return (factor, info); the info scalar is computed
             # identically on every rank (replicated P() output)
             P = jax.sharding.PartitionSpec
-            _kernel_cache[key] = coll.spmd(
+            return coll.spmd(
                 grid,
                 partial(kern_fn, g=g, want_info=True),
                 donate_argnums=(0,),
                 out_specs=(P(ROW_AXIS, COL_AXIS), P()),
             )
-        else:
-            _kernel_cache[key] = coll.spmd(
-                grid, partial(kern_fn, g=g), donate_argnums=(0,)
-            )
-    return _kernel_cache[key]
+        return coll.spmd(grid, partial(kern_fn, g=g), donate_argnums=(0,))
 
-
-_range_cache = {}
+    return _plan.cached("cholesky", (grid.cache_key, g, uplo, variant, want_info),
+                        build)
 
 
 def _compiled_range(grid, g: _spmd.Geometry):
@@ -448,9 +437,7 @@ def _compiled_range(grid, g: _spmd.Geometry):
     one executable serves every segment and every resumed continuation.
     Built directly on ``shard_map_compat`` (not :func:`coll.spmd`, whose
     uniform ``P('r','c')`` in_specs would shard the scalar bounds)."""
-    key = (grid.cache_key, g, _spmd.trsm_trace_key(), coll.collectives_trace_key(),
-           _spmd.serve_trace_key(), _spmd.gemm_precision_trace_key())
-    if key not in _range_cache:
+    def build():
         P = jax.sharding.PartitionSpec
         spec = P(ROW_AXIS, COL_AXIS)
         sm = coll.shard_map_compat(
@@ -459,8 +446,9 @@ def _compiled_range(grid, g: _spmd.Geometry):
             in_specs=(spec, P(), P(), P()),
             out_specs=(spec, P()),
         )
-        _range_cache[key] = jax.jit(sm, donate_argnums=(0,))
-    return _range_cache[key]
+        return jax.jit(sm, donate_argnums=(0,))
+
+    return _plan.cached("cholesky_range", (grid.cache_key, g), build)
 
 
 def _factor_checkpointed(mat_a, g: _spmd.Geometry, checkpoint_every: int,
@@ -501,9 +489,6 @@ def _factor_checkpointed(mat_a, g: _spmd.Geometry, checkpoint_every: int,
     return mat_a.data, info
 
 
-_local_cache = {}
-
-
 def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedMatrix:
     """1x1-grid fast path: XLA's built-in blocked Cholesky on the dense
     matrix (the TPU analogue of the reference dispatching tile potrf to
@@ -516,10 +501,8 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, np.dtype(mat_a.dtype), uplo, _spmd.trsm_trace_key(),
-           _spmd.serve_trace_key(), _spmd.gemm_precision_trace_key())
-    if key not in _local_cache:
 
+    def build():
         @jax.jit
         def run(x):
             g_ = layout.unpad_global(layout.unpack(x, dist), dist)
@@ -533,9 +516,11 @@ def _cholesky_single_device(uplo: str, mat_a: DistributedMatrix) -> DistributedM
                 out = fac + jnp.tril(g_, -1)
             return layout.pack(layout.pad_global(out, dist), dist)
 
-        _local_cache[key] = run
+        return run
+
+    fn = _plan.cached("cholesky_local", (dist, np.dtype(mat_a.dtype), uplo), build)
     with blas3_precision():
-        return mat_a._inplace(_local_cache[key](mat_a.data))
+        return mat_a._inplace(fn(mat_a.data))
 
 
 def _factor_with_recovery(mat_a, g, variant, max_shift_attempts):

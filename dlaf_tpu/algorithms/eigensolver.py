@@ -223,9 +223,6 @@ def _band_stage_hh(band_mat: DistributedMatrix, band: int, want_q: bool = True):
     return None, None
 
 
-_eigh_cache = {}
-
-
 def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
     """Single-device fast path: XLA eigh on the hermitized dense matrix.
     Partial spectra slice the eigenvector block ON DEVICE (the unpack ->
@@ -251,21 +248,18 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
         )
     # two jits: the expensive eigh compiles once per (dist, dtype); each
     # spectrum slice only adds a tiny slice-and-pack executable
-    from dlaf_tpu.algorithms import _spmd
+    from dlaf_tpu.plan import core as _plan
 
-    key = (dist, np.dtype(mat_a.dtype), _spmd.serve_trace_key())
-    if key not in _eigh_cache:
-
+    def build_eigh():
         @jax.jit
         def run(x):
             g = layout.unpad_global(layout.unpack(x, dist), dist)
             full = jnp.tril(g) + jnp.swapaxes(jnp.tril(g, -1), -1, -2).conj()
             return jnp.linalg.eigh(full)  # dense (w, v), on device
 
-        _eigh_cache[key] = run
-    pkey = ("pack", dist, np.dtype(mat_a.dtype), sl, _spmd.serve_trace_key())
-    if pkey not in _eigh_cache:
+        return run
 
+    def build_pack():
         @jax.jit
         def packrun(w, v):
             if sl is not None:
@@ -273,8 +267,15 @@ def _eigh_single_device(mat_a: DistributedMatrix, spectrum) -> EigResult:
                 v = v[:, sl[0] : sl[1] + 1]
             return w, layout.pack(layout.pad_global(v, out_dist), out_dist)
 
-        _eigh_cache[pkey] = packrun
-    w, vdata = _eigh_cache[pkey](*_eigh_cache[key](mat_a.data))
+        return packrun
+
+    eigh_fn = _plan.cached(
+        "eigh_local", (dist, np.dtype(mat_a.dtype)), build_eigh
+    )
+    pack_fn = _plan.cached(
+        "eigh_local_pack", (dist, np.dtype(mat_a.dtype), sl), build_pack
+    )
+    w, vdata = pack_fn(*eigh_fn(mat_a.data))
     evecs = DistributedMatrix(
         out_dist, mat_a.grid, jax.device_put(vdata, mat_a.grid.stacked_sharding())
     )

@@ -50,6 +50,7 @@ from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
@@ -120,14 +121,10 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
     return coll.relocal(a)
 
 
-_cache: dict = {}
-
-
 def _tile_mask(mat: DistributedMatrix, rel: str) -> DistributedMatrix:
     """Keep only tiles with row-tile ``rel`` col-tile ('lt' = strictly
     lower, 'diag' = diagonal); zero the rest."""
-    key = ("tmask", rel, mat.dist, np.dtype(mat.dtype))
-    if key not in _cache:
+    def build():
         d = mat.dist
 
         @jax.jit
@@ -137,8 +134,10 @@ def _tile_mask(mat: DistributedMatrix, rel: str) -> DistributedMatrix:
             keep = (ti > tj) if rel == "lt" else (ti == tj)
             return jnp.where(keep, x, jnp.zeros_like(x))
 
-        _cache[key] = run
-    return mat.like(_cache[key](mat.data))
+        return run
+
+    fn = _plan.cached("hegst_tmask", (rel, mat.dist, np.dtype(mat.dtype)), build)
+    return mat.like(fn(mat.data))
 
 
 def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix):
@@ -151,22 +150,17 @@ def _gen_to_std_fused(mat_a_full: DistributedMatrix, mat_b_l: DistributedMatrix)
         return mat_a_full
     if (g.mb, g.pr, g.pc, g.mt) != (g_b.mb, g_b.pr, g_b.pc, g_b.mt):
         raise ValueError("gen_to_std: A and B distributions must match")
-    from dlaf_tpu.tune import get_tune_parameters
 
-    # trsm_lookahead is only traced by the phase-B triangular_solver call
-    # (own kernel cache); carrying it here over-keys phase A harmlessly
-    # (same idiom as serve._trace_knobs) and keeps DLAF001 exact
-    lookahead = bool(get_tune_parameters().trsm_lookahead)
-    key = ("phaseA", mat_a_full.grid.cache_key, g, _spmd.bucket_ratio(), _spmd.trsm_trace_key(),
-           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(), lookahead)
-    if key not in _cache:
-        _cache[key] = coll.spmd(
+    def build():
+        return coll.spmd(
             mat_a_full.grid,
             partial(_hegst_phase_a_kernel, g=g),
             donate_argnums=(0,),
         )
+
+    fn = _plan.cached("hegst_phase_a", (mat_a_full.grid.cache_key, g), build)
     with blas3_precision():
-        ph_a = mat_a_full._inplace(_cache[key](mat_a_full.data, mat_b_l.data))
+        ph_a = mat_a_full._inplace(fn(mat_a_full.data, mat_b_l.data))
         # phase B: the deferred per-panel inv(L_trail) solves = one full
         # left-trsm on the strictly-lower-tile part (supported below each
         # diagonal block, so inv(L) acts as the per-panel inv(L_trail))

@@ -37,6 +37,7 @@ from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _trtri_lower_kernel(x, g: _spmd.Geometry, diag):
@@ -213,10 +214,6 @@ def _trtri_upper_kernel(x, g: _spmd.Geometry, diag):
     return coll.relocal(x)
 
 
-_cache = {}
-_local_cache = {}
-
-
 def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> DistributedMatrix:
     """1x1-grid fast path: dense triangular solve against the identity."""
     import jax
@@ -226,10 +223,8 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
     from dlaf_tpu.tune import blas3_precision
 
     dist = mat_a.dist
-    key = (dist, str(mat_a.dtype), uplo, diag, _spmd.trsm_trace_key(),
-           _spmd.gemm_precision_trace_key())
-    if key not in _local_cache:
 
+    def build():
         @jax.jit
         def run(x):
             g_ = layout.unpad_global(layout.unpack(x, dist), dist)
@@ -242,9 +237,11 @@ def _trtri_single_device(uplo: str, diag: str, mat_a: DistributedMatrix) -> Dist
                 out = jnp.triu(inv) + jnp.tril(g_, -1)
             return layout.pack(layout.pad_global(out, dist), dist)
 
-        _local_cache[key] = run
+        return run
+
+    fn = _plan.cached("trtri_local", (dist, str(mat_a.dtype), uplo, diag), build)
     with blas3_precision():
-        return mat_a._inplace(_local_cache[key](mat_a.data))
+        return mat_a._inplace(fn(mat_a.data))
 
 
 @origin_transparent
@@ -260,19 +257,17 @@ def triangular_inverse(uplo: str, diag: str, mat_a: DistributedMatrix) -> Distri
         return _trtri_single_device(uplo, diag, mat_a)
     from dlaf_tpu.tune import blas3_precision
 
-    # bucketed kernels bake ratio-dependent trailing windows at trace time
-    ratio = _spmd.bucket_ratio()
-    key = (mat_a.grid.cache_key, uplo, diag, g, ratio, _spmd.trsm_trace_key(),
-           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
-    if key not in _cache:
+    def build():
         kern_fn = (
             _trtri_lower_bucketed_kernel if uplo == t.LOWER else _trtri_upper_bucketed_kernel
         )
-        _cache[key] = coll.spmd(
+        return coll.spmd(
             mat_a.grid, partial(kern_fn, g=g, diag=diag), donate_argnums=(0,)
         )
+
+    fn = _plan.cached("trtri", (mat_a.grid.cache_key, uplo, diag, g), build)
     with blas3_precision():
-        return mat_a._inplace(_cache[key](mat_a.data))
+        return mat_a._inplace(fn(mat_a.data))
 
 
 @origin_transparent

@@ -39,6 +39,7 @@ from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 # A-panel structure masks
 _FULL = "full"
@@ -158,10 +159,6 @@ def _summa_kernel(
     return coll.relocal(c)
 
 
-_cache = {}
-_local_cache = {}
-
-
 def _dense_structured_a(ga, structure, diag):
     """Materialize the structured operand on a 1x1 grid (dense fast path)."""
     if structure == _FULL:
@@ -185,12 +182,7 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
     from dlaf_tpu.tune import blas3_precision
 
     da, db, dc = mat_a.dist, mat_b.dist, mat_c.dist
-    key = (
-        "local", da, db, dc, np.dtype(mat_c.dtype), opa, opb,
-        complex(alpha), complex(beta), structure, diag, a_right,
-        _spmd.gemm_precision_trace_key(),
-    )
-    if key not in _local_cache:
+    def build():
         from dlaf_tpu.matrix import layout
 
         @jax.jit
@@ -208,9 +200,16 @@ def _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag
             out = jnp.asarray(alpha, gc.dtype) * prod + jnp.asarray(beta, gc.dtype) * gc
             return layout.pack(layout.pad_global(out.astype(gc.dtype), dc), dc)
 
-        _local_cache[key] = run
+        return run
+
+    fn = _plan.cached(
+        "gemm_local",
+        (da, db, dc, np.dtype(mat_c.dtype), opa, opb, complex(alpha),
+         complex(beta), structure, diag, a_right),
+        build,
+    )
     with blas3_precision():
-        return mat_c._inplace(_local_cache[key](mat_a.data, mat_b.data, mat_c.data))
+        return mat_c._inplace(fn(mat_a.data, mat_b.data, mat_c.data))
 
 
 def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
@@ -223,19 +222,21 @@ def _run_summa(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, kt):
         return mat_c
     if mat_c.grid.grid_size.count() == 1:
         return _run_dense_local(mat_a, mat_b, mat_c, opa, opb, alpha, beta, structure, diag, False)
-    key = (
-        mat_c.grid.cache_key, opa, opb, complex(alpha), complex(beta), structure,
-        diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
-        _spmd.gemm_precision_trace_key(),
-    )
-    if key not in _cache:
+    def build():
         kern = partial(
             _summa_kernel, g_a=g_a, g_b=g_b, g_c=g_c, opa=opa, opb=opb,
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
-        _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+        return coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+
+    fn = _plan.cached(
+        "summa",
+        (mat_c.grid.cache_key, opa, opb, complex(alpha), complex(beta),
+         structure, diag, kt, g_a, g_b, g_c),
+        build,
+    )
     with blas3_precision():
-        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+        return mat_c._inplace(fn(mat_a.data, mat_b.data, mat_c.data))
 
 
 @origin_transparent
@@ -361,19 +362,21 @@ def _run_summa_right(mat_a, mat_b, mat_c, opa, alpha, structure, diag, beta=0.0)
     if mat_c.grid.grid_size.count() == 1:
         return _run_dense_local(mat_a, mat_b, mat_c, opa, t.NO_TRANS, alpha, beta, structure, diag, True)
     kt = g_b.nt
-    key = (
-        "right", mat_c.grid.cache_key, opa, complex(alpha), complex(beta),
-        structure, diag, kt, g_a, g_b, g_c, coll.collectives_trace_key(),
-        _spmd.gemm_precision_trace_key(),
-    )
-    if key not in _cache:
+    def build():
         kern = partial(
             _summa_right_kernel, g_a=g_a, g_b=g_b, g_c=g_c, opa=opa,
             alpha=alpha, beta=beta, structure=structure, diag=diag, kt=kt,
         )
-        _cache[key] = coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+        return coll.spmd(mat_c.grid, kern, donate_argnums=(2,))
+
+    fn = _plan.cached(
+        "summa_right",
+        (mat_c.grid.cache_key, opa, complex(alpha), complex(beta), structure,
+         diag, kt, g_a, g_b, g_c),
+        build,
+    )
     with blas3_precision():
-        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+        return mat_c._inplace(fn(mat_a.data, mat_b.data, mat_c.data))
 
 
 def _sub_gemm_kernel(
@@ -531,23 +534,25 @@ def general_sub_multiplication(
     aliased = (mat_a.data is mat_c.data) or (mat_b.data is mat_c.data)
     from dlaf_tpu.tune import blas3_precision
 
-    key = (
-        "subgemm", mat_c.grid.cache_key, complex(alpha), complex(beta),
-        origins, Ri, Rj, Rk, g_a, g_b, g_c, aliased,
-        coll.collectives_trace_key(), _spmd.gemm_precision_trace_key(),
-    )
-    if key not in _cache:
+    def build():
         kern = partial(
             _sub_gemm_kernel, g_a=g_a, g_b=g_b, g_c=g_c,
             ai0=origins[0], ak0=origins[1], bk0=origins[2], bj0=origins[3],
             ci0=origins[4], cj0=origins[5], Ri=Ri, Rj=Rj, Rk=Rk, L=L, Cw=Cw,
             alpha=alpha, beta=beta,
         )
-        _cache[key] = coll.spmd(
+        return coll.spmd(
             mat_c.grid, kern, donate_argnums=() if aliased else (2,)
         )
+
+    fn = _plan.cached(
+        "sub_gemm",
+        (mat_c.grid.cache_key, complex(alpha), complex(beta), origins,
+         Ri, Rj, Rk, g_a, g_b, g_c, aliased),
+        build,
+    )
     with blas3_precision():
-        return mat_c._inplace(_cache[key](mat_a.data, mat_b.data, mat_c.data))
+        return mat_c._inplace(fn(mat_a.data, mat_b.data, mat_c.data))
 
 
 def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
@@ -559,10 +564,7 @@ def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
     da, db, dc = a_ref.parent.dist, b_ref.parent.dist, c_ref.parent.dist
     oa, ob, oc = tuple(a_ref.origin), tuple(b_ref.origin), tuple(c_ref.origin)
     sa, sb, sc = tuple(a_ref.size), tuple(b_ref.size), tuple(c_ref.size)
-    key = ("sublocal", da, db, dc, oa, ob, oc, sa, sb, sc,
-           np.dtype(c_ref.dtype), complex(alpha), complex(beta),
-           _spmd.gemm_precision_trace_key())
-    if key not in _local_cache:
+    def build():
         from dlaf_tpu.matrix import layout
 
         @jax.jit
@@ -579,10 +581,17 @@ def _sub_gemm_local(alpha, a_ref, b_ref, beta, c_ref):
             gc = lax.dynamic_update_slice(gc, new.astype(gc.dtype), oc)
             return layout.pack(layout.pad_global(gc, dc), dc)
 
-        _local_cache[key] = run
+        return run
+
+    fn = _plan.cached(
+        "sub_gemm_local",
+        (da, db, dc, oa, ob, oc, sa, sb, sc, np.dtype(c_ref.dtype),
+         complex(alpha), complex(beta)),
+        build,
+    )
     with blas3_precision():
         return c_ref.parent._inplace(
-            _local_cache[key](a_ref.parent.data, b_ref.parent.data, c_ref.parent.data)
+            fn(a_ref.parent.data, b_ref.parent.data, c_ref.parent.data)
         )
 
 

@@ -32,8 +32,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import layout
 from dlaf_tpu.matrix.matrix import DistributedMatrix
-
-_cache: dict = {}
+from dlaf_tpu.plan import core as _plan
 
 
 @partial(jax.jit, static_argnums=(2, 3))
@@ -108,8 +107,8 @@ def _permute_cols_kernel(x, perm, g: Geometry):
 
 def _ring_fn(grid, dist, coord):
     g = Geometry.of(dist)
-    key = (grid.cache_key, g, coord)
-    if key not in _cache:
+
+    def build():
         kern = _permute_rows_kernel if coord == "rows" else _permute_cols_kernel
         stacked = P(ROW_AXIS, COL_AXIS)
         sm = coll.shard_map_compat(
@@ -118,8 +117,9 @@ def _ring_fn(grid, dist, coord):
             in_specs=(stacked, P()),
             out_specs=stacked,
         )
-        _cache[key] = jax.jit(sm)
-    return _cache[key]
+        return jax.jit(sm)
+
+    return _plan.cached("permute_ring", (grid.cache_key, g, coord), build)
 
 
 @origin_transparent

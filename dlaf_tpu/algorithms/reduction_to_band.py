@@ -50,6 +50,7 @@ from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _panel_block_size(nb: int) -> int:
@@ -263,19 +264,13 @@ def _red2band_range_kernel(x, taus_all, p0, p1, g: _spmd.Geometry, band: int):
     return coll.relocal(x), taus_all
 
 
-_cache = {}
-_range_cache = {}
-
-
 def _compiled_range(grid, g: _spmd.Geometry, band: int, prec: str):
     """Compiled checkpoint-segment executable:
     ``(x, taus_all, p0, p1) -> (x, taus_all)`` with traced panel bounds and
     a replicated taus carry.  Built on ``shard_map_compat`` directly — the
     scalar bounds and the replicated taus stack need ``P()`` in_specs that
     :func:`coll.spmd`'s uniform stacked specs cannot express."""
-    key = (grid.cache_key, g, band, prec, coll.collectives_trace_key(),
-           _spmd.gemm_precision_trace_key())
-    if key not in _range_cache:
+    def build():
         P = jax.sharding.PartitionSpec
         spec = P(ROW_AXIS, COL_AXIS)
         sm = coll.shard_map_compat(
@@ -284,8 +279,9 @@ def _compiled_range(grid, g: _spmd.Geometry, band: int, prec: str):
             in_specs=(spec, P(), P(), P()),
             out_specs=(spec, P()),
         )
-        _range_cache[key] = jax.jit(sm, donate_argnums=(0,))
-    return _range_cache[key]
+        return jax.jit(sm, donate_argnums=(0,))
+
+    return _plan.cached("red2band_range", (grid.cache_key, g, band, prec), build)
 
 
 def _reduce_checkpointed(full, g: _spmd.Geometry, band: int, n_panels: int,
@@ -403,13 +399,15 @@ def reduction_to_band(
         out = mat_a.like(data)
         out.band_size = band
         return out, taus
-    key = (mat_a.grid.cache_key, g, band, prec, _spmd.bucket_ratio(),
-           coll.collectives_trace_key(), _spmd.gemm_precision_trace_key())
-    if key not in _cache:
+    def build():
         kern = partial(_red2band_kernel, g=g, n_panels=n_panels, band=band)
-        _cache[key] = coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
+        return coll.spmd(mat_a.grid, kern, donate_argnums=(0,))
+
+    fn = _plan.cached(
+        "red2band", (mat_a.grid.cache_key, g, band, n_panels, prec), build
+    )
     with matmul_precision(prec):
-        data, taus_stack = _cache[key](full.data)
+        data, taus_stack = fn(full.data)
     full.data = data  # the hermitized copy was donated
     out = mat_a.like(data)
     out.band_size = band  # consumed as the default by band_to_tridiagonal*

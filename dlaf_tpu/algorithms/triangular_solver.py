@@ -28,6 +28,7 @@ from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import core as _plan
 
 
 def _trsm_left_kernel(a, b, g_a: _spmd.Geometry, g_b: _spmd.Geometry, uplo, op, diag, alpha):
@@ -346,10 +347,9 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
     return coll.relocal(b)
 
 
-_cache = {}
-
-
-_local_cache = {}
+# dense-solve geometries the backend compiler refused (not executables —
+# a retry memo, so the SPMD fallback is remembered per shape)
+_dense_fail: set = set()
 
 
 def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
@@ -362,11 +362,8 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
     from dlaf_tpu.tune import blas3_precision
 
     da, db = mat_a.dist, mat_b.dist
-    key = (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha),
-           _spmd.trsm_trace_key(), _spmd.serve_trace_key(),
-           _spmd.gemm_precision_trace_key())
-    if key not in _local_cache:
 
+    def build():
         @jax.jit
         def run(xa, xb):
             ga = layout.unpad_global(layout.unpack(xa, da), da)
@@ -374,9 +371,15 @@ def _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b):
             out = t.trsm(side, uplo, op, diag, jnp.asarray(alpha, gb.dtype), ga, gb)
             return layout.pack(layout.pad_global(out, db), db)
 
-        _local_cache[key] = run
+        return run
+
+    fn = _plan.cached(
+        "trsm_local",
+        (da, db, np.dtype(mat_b.dtype), side, uplo, op, diag, complex(alpha)),
+        build,
+    )
     with blas3_precision():
-        return mat_b._inplace(_local_cache[key](mat_a.data, mat_b.data))
+        return mat_b._inplace(fn(mat_a.data, mat_b.data))
 
 
 @origin_transparent
@@ -421,14 +424,14 @@ def triangular_solver(
     if g_b.mt == 0 or g_b.nt == 0 or g_a.mt == 0:
         return mat_b
     if backend == "auto" and mat_b.grid.grid_size.count() == 1:
-        fail_key = ("fail", mat_b.size, np.dtype(mat_b.dtype))
-        if fail_key not in _local_cache:
+        fail_key = (mat_b.size, np.dtype(mat_b.dtype))
+        if fail_key not in _dense_fail:
             try:
                 return _trsm_single_device(side, uplo, op, diag, alpha, mat_a, mat_b)
             except Exception:
                 # e.g. backend compiler limits on very large dense solves —
                 # remember and use the tiled SPMD kernel instead
-                _local_cache[fail_key] = True
+                _dense_fail.add(fail_key)
     from dlaf_tpu.tune import get_tune_parameters
 
     lookahead = side == t.LEFT and get_tune_parameters().trsm_lookahead and g_a.mt > 1
@@ -438,20 +441,18 @@ def triangular_solver(
         kern_fn = _trsm_right_bucketed_kernel
     from dlaf_tpu.tune import blas3_precision
 
-    # only the bucketed kernels bake ratio-dependent segments
-    ratio = (
-        _spmd.bucket_ratio()
-        if kern_fn in (_trsm_left_bucketed_kernel, _trsm_right_bucketed_kernel)
-        else None
-    )
-    key = (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), _spmd.trsm_trace_key(), g_a, g_b,
-           lookahead, ratio, coll.collectives_trace_key(), _spmd.serve_trace_key(),
-           _spmd.gemm_precision_trace_key())
-    if key not in _cache:
+    def build():
         kern = partial(kern_fn, g_a=g_a, g_b=g_b, uplo=uplo, op=op, diag=diag, alpha=alpha)
-        _cache[key] = coll.spmd(mat_b.grid, kern, donate_argnums=(1,))
+        return coll.spmd(mat_b.grid, kern, donate_argnums=(1,))
+
+    fn = _plan.cached(
+        "trsm",
+        (mat_b.grid.cache_key, side, uplo, op, diag, complex(alpha), g_a, g_b,
+         lookahead),
+        build,
+    )
     with blas3_precision():
-        return mat_b._inplace(_cache[key](mat_a.data, mat_b.data))
+        return mat_b._inplace(fn(mat_a.data, mat_b.data))
 
 
 def _trsm_refined(side, uplo, op, diag, alpha, mat_a, x, b_snap, backend,

@@ -566,9 +566,6 @@ def _level_kernel(x, *arrs, g, S, B, n_pad, dt, rot):
 # driver
 # --------------------------------------------------------------------------
 
-_cache = {}
-
-
 def _geometry(dist):
     from dlaf_tpu.algorithms._spmd import Geometry
 
@@ -636,18 +633,22 @@ def tridiag_dc_distributed(
         grid.cache_key, n_pad, s0, nb, str(dt), prec,
         bool(getattr(get_tune_parameters(), "dc_secular_pallas", False)),
     )
-    if ("leaf",) + key0 not in _cache:
+    from dlaf_tpu.plan import core as _plancache
+
+    def build_leaf():
         nloc = -(-nleaf // Ptot)
-        _cache[("leaf",) + key0] = _spmd(
+        return _spmd(
             grid,
             partial(_leaf_kernel, g=g, s0=s0, nleaf=nleaf, nloc=nloc, dt=dt),
             in_specs=(rep, rep),
             out_specs=(stacked, rep),
         )
+
+    leaf_fn = _plancache.cached("dc_leaf", key0, build_leaf)
     dm_dev = jnp.asarray(d_mod)
     ep_dev = jnp.asarray(e_pad)
     with matmul_precision(prec):
-        x, lam = _cache[("leaf",) + key0](dm_dev, ep_dev)
+        x, lam = leaf_fn(dm_dev, ep_dev)
 
     for lvl in range(L):
         S = (s0 << lvl) * 2
@@ -655,9 +656,8 @@ def tridiag_dc_distributed(
         RPD = -(-n_pad // Ptot)
         mids = np.arange(B) * S + S // 2
         beta_l = jnp.asarray(e_pad[mids - 1])
-        pkey = ("params", lvl) + key0
-        if pkey not in _cache:
-            _cache[pkey] = _spmd(
+        def build_params(S=S, B=B, RPD=RPD):
+            return _spmd(
                 grid,
                 partial(
                     _params_kernel, g=g, S=S, B=B, n_pad=n_pad, RPD=RPD,
@@ -666,21 +666,24 @@ def tridiag_dc_distributed(
                 in_specs=(stacked, rep, rep),
                 out_specs=tuple([rep] * 16),
             )
+
+        params_fn = _plancache.cached("dc_params", (lvl,) + key0, build_params)
         with matmul_precision(prec):
-            prm = _cache[pkey](x, lam, beta_l)
+            prm = params_fn(x, lam, beta_l)
         lam = prm[0]
         has_rot = bool(prm[15])
-        gkey = ("gemm", lvl, has_rot) + key0
-        if gkey not in _cache:
-            _cache[gkey] = _spmd(
+        def build_gemm(S=S, B=B, has_rot=has_rot):
+            return _spmd(
                 grid,
                 partial(_level_kernel, g=g, S=S, B=B, n_pad=n_pad, dt=dt, rot=has_rot),
                 in_specs=tuple([stacked] + [rep] * 14),
                 out_specs=stacked,
                 donate=(0,),
             )
+
+        gemm_fn = _plancache.cached("dc_gemm", (lvl, has_rot) + key0, build_gemm)
         with matmul_precision(prec):
-            x = _cache[gkey](x, *prm[1:15])
+            x = gemm_fn(x, *prm[1:15])
 
     w = np.asarray(lam)[:n]
     mat = DistributedMatrix(dist, grid, x)

@@ -19,6 +19,18 @@ Detection, per function ``F`` in the indexed project:
   second argument.  Reads = the transitive knobs of the *builder* only
   (the driver around it reads admission knobs — ``serve_cache_capacity``,
   ``serve_buckets`` — that are deliberately not trace state).
+* **plan form** — ``<*plan*>.cached(op, static_key, builder)``.  Reads =
+  every knob transitively reachable from ``F`` (builders are nested
+  closures folded into ``F`` by the indexer).  Coverage additionally
+  includes the knobs behind ``dlaf_tpu.plan.core.trace_suffix()`` — the
+  plan layer appends that suffix to every key in ONE place, which is the
+  whole point of the unification: deleting an element from
+  ``trace_suffix`` re-opens the dead-knob hole at every call site at
+  once, and this rule reports it at every call site at once.
+* **module-dict form** — a NEW module-level ``_*cache* = {}`` outside
+  ``dlaf_tpu.plan`` is itself a finding: the plan registry is the single
+  audited cache site; ad-hoc dicts dodge the key discipline, the
+  eviction/metrics plumbing and the AOT warmup path.
 
 Coverage = knobs attributable to the key expression: direct reads in it,
 transitive knobs of functions it calls (``_spmd.trsm_trace_key()``,
@@ -48,6 +60,17 @@ RULE = "DLAF001"
 SUMMARY = "trace-time tune knob read by a cached-kernel builder but missing from the cache key"
 
 _CACHE_NAME_HINT = "cache"
+_PLAN_MODULE = "dlaf_tpu.plan"
+
+
+def _suffix_knobs(project) -> frozenset:
+    """Knobs covered by ``plan.core.trace_suffix()`` — appended to every
+    plan key in one place, so every ``plan.cached`` / ``CompiledCache.get``
+    site is covered for them without spelling per-site tuples."""
+    info = project.functions.get("dlaf_tpu.plan.core:trace_suffix")
+    if info is None:
+        return frozenset()
+    return project.transitive_knobs(info.qualname)
 
 
 def _expr_text(node) -> str:
@@ -170,13 +193,43 @@ def _key_expr_for(name_or_expr, cov):
     return [name_or_expr]
 
 
+def _module_dict_findings(project):
+    """Module-level cache dicts outside ``dlaf_tpu.plan``: the plan
+    registry is the single audited cache site."""
+    out = []
+    for f in project.files:
+        if f.module.startswith(_PLAN_MODULE):
+            continue
+        for node in f.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict)                     and not node.value.keys:
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name)                     and isinstance(node.value, ast.Dict) and not node.value.keys:
+                targets = [node.target]
+            for tgt in targets:
+                if _CACHE_NAME_HINT not in tgt.id.lower():
+                    continue
+                out.append(Finding(
+                    rule=RULE, path=f.rel, line=node.lineno, col=node.col_offset,
+                    symbol=tgt.id,
+                    message=(
+                        f"module-level cache dict '{tgt.id}' outside "
+                        f"dlaf_tpu.plan — route compiled executables through "
+                        f"dlaf_tpu.plan.cached so keys carry trace_suffix()"
+                    ),
+                ))
+    return out
+
+
 def check(project):
-    findings = []
+    findings = _module_dict_findings(project)
+    suffix = _suffix_knobs(project)
     for info in project.functions.values():
         file = project.by_module.get(info.module)
         if file is None:
             continue
         class_name = _class_of(info)
+        in_plan = info.module.startswith(_PLAN_MODULE)
         cov = None
         for sub in ast.walk(info.node):
             # ---- dict-store form:  *cache*[key] = <executable>
@@ -204,8 +257,25 @@ def check(project):
                     and isinstance(sub.args[1], (ast.Lambda, ast.Name, ast.Attribute)):
                 cov = cov or _KeyCoverage(project, info.module, class_name, info.node)
                 reads = _builder_reads(project, info, sub.args[1])
-                covered = set()
+                covered = set(suffix)  # CompiledCache.get delegates to plan.cached
                 for expr in _key_expr_for(sub.args[0], cov):
+                    covered |= cov.knobs(expr)
+                findings.extend(_report(
+                    project, file, info, sub, reads, covered,
+                    cache_name=_expr_text(sub.func.value),
+                ))
+            # ---- plan form:  <*plan*>.cached(op, static_key, builder)
+            elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "cached" and len(sub.args) == 3 \
+                    and not in_plan \
+                    and "plan" in _expr_text(sub.func.value).lower():
+                cov = cov or _KeyCoverage(project, info.module, class_name, info.node)
+                reads = {
+                    k: project.knob_witness(info.qualname, k)
+                    for k in project.transitive_knobs(info.qualname)
+                }
+                covered = set(suffix)
+                for expr in _key_expr_for(sub.args[1], cov):
                     covered |= cov.knobs(expr)
                 findings.extend(_report(
                     project, file, info, sub, reads, covered,

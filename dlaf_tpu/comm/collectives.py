@@ -96,16 +96,20 @@ def _impl() -> str:
     """Resolve ``tune.collectives_impl`` to the active tier
     ('psum'|'v2'|'pallas').
 
-    ``'auto'`` picks v2 on accelerator backends and psum on CPU (where the
-    masked all-reduce benchmarks at parity and stays the measured default);
-    it never resolves to pallas — that tier is explicit-opt-in until a live
-    TPU A/B (scripts/tpu_day.sh stage 5f) justifies promotion.  Read lazily
-    so comm does not import tune at module load."""
+    ``'auto'`` consults the plan autotuner: a loaded sweep profile's
+    measured winner when one exists, else the analytic rule (v2 on
+    accelerator backends, psum on CPU where the masked all-reduce
+    benchmarks at parity).  It never resolves to pallas — that tier is
+    explicit-opt-in until a live TPU A/B (scripts/tpu_day.sh stage 5f)
+    justifies promotion.  Read lazily so comm does not import tune at
+    module load."""
     from dlaf_tpu import tune
 
     impl = tune.get_tune_parameters().collectives_impl
     if impl == "auto":
-        return "v2" if jax.default_backend() != "cpu" else "psum"
+        from dlaf_tpu.plan import autotune
+
+        return autotune.collectives_tier(jax.default_backend())
     tune.validate_collectives_impl(impl)  # ConfigurationError on typos
     return impl
 

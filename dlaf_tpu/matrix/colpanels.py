@@ -24,8 +24,6 @@ from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 
-_pack_cache: dict = {}
-
 
 @dataclass
 class ColPanels:
@@ -47,13 +45,19 @@ def pack_to_matrix(cp: ColPanels) -> DistributedMatrix:
     # bind scalars locally: the cached closure must NOT capture cp (it
     # would pin cp.data, an E-sized device buffer, for the process life)
     n, k, dist = cp.n, cp.k, cp.dist
-    key = (cp.grid.cache_key, dist, n, k, tuple(cp.data.shape), cp.data.dtype)
-    if key not in _pack_cache:
+    from dlaf_tpu.plan import core as _plan
 
+    grid = cp.grid
+
+    def build():
         def post(gp):
             return layout.pack(layout.pad_global(gp[:n, :k], dist), dist)
 
-        _pack_cache[key] = jax.jit(
-            post, out_shardings=cp.grid.stacked_sharding()
-        )
-    return DistributedMatrix(dist, cp.grid, _pack_cache[key](cp.data))
+        return jax.jit(post, out_shardings=grid.stacked_sharding())
+
+    fn = _plan.cached(
+        "colpanels_pack",
+        (grid.cache_key, dist, n, k, tuple(cp.data.shape), cp.data.dtype),
+        build,
+    )
+    return DistributedMatrix(dist, grid, fn(cp.data))

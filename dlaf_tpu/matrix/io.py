@@ -68,9 +68,6 @@ def load_global(path: str, name: str = "a") -> np.ndarray:
         return z["data"]
 
 
-_row_fetch_cache: dict = {}
-
-
 def _row_fetch_fn(grid: Grid, shape, dtype):
     """Jitted REPLICATED fetch of one tile-ROW stack [Pc, ltc, mb, nb] at
     traced (rr, li) — the mirror of :func:`_row_update_fn`.  The replicated
@@ -81,9 +78,9 @@ def _row_fetch_fn(grid: Grid, shape, dtype):
     import jax
     from jax import lax
 
-    key = (grid.cache_key, shape, str(np.dtype(dtype)))
-    if key not in _row_fetch_cache:
+    from dlaf_tpu.plan import core as _plan
 
+    def build():
         def fetch(x, rr, li):
             z = np.int32(0)  # starts must share one integer type
             row = lax.dynamic_slice(
@@ -93,12 +90,15 @@ def _row_fetch_fn(grid: Grid, shape, dtype):
             )
             return row[0, :, 0]
 
-        _row_fetch_cache[key] = jax.jit(
+        return jax.jit(
             fetch,
             in_shardings=(grid.stacked_sharding(), None, None),
             out_shardings=grid.replicated_sharding(),
         )
-    return _row_fetch_cache[key]
+
+    return _plan.cached(
+        "io_row_fetch", (grid.cache_key, shape, str(np.dtype(dtype))), build
+    )
 
 
 def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a",
@@ -165,9 +165,6 @@ def save_hdf5(path: str, mat: DistributedMatrix, name: str = "a",
         multihost_utils.sync_global_devices("dlaf_tpu.matrix.io.save_hdf5")
 
 
-_row_update_cache: dict = {}
-
-
 def _row_update_fn(grid: Grid, shape, dtype):
     """Jitted donated update placing one tile-ROW stack [Pc, ltc, mb, nb]
     into the stacked array at traced (rr, li) — one compile serves every
@@ -175,16 +172,16 @@ def _row_update_fn(grid: Grid, shape, dtype):
     import jax
     from jax import lax
 
-    key = (grid.cache_key, shape, str(np.dtype(dtype)))
-    if key not in _row_update_cache:
+    from dlaf_tpu.plan import core as _plan
 
+    def build():
         def upd(x, row, rr, li):
             z = np.int32(0)  # starts must share one integer type
             return lax.dynamic_update_slice(
                 x, row[None, :, None], (rr, z, li, z, z, z)
             )
 
-        _row_update_cache[key] = jax.jit(
+        return jax.jit(
             upd,
             donate_argnums=(0,),
             in_shardings=(
@@ -195,7 +192,10 @@ def _row_update_fn(grid: Grid, shape, dtype):
             ),
             out_shardings=grid.stacked_sharding(),
         )
-    return _row_update_cache[key]
+
+    return _plan.cached(
+        "io_row_update", (grid.cache_key, shape, str(np.dtype(dtype))), build
+    )
 
 
 def load_hdf5(

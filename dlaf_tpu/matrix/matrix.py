@@ -28,9 +28,6 @@ from dlaf_tpu.matrix import layout
 from dlaf_tpu.matrix.distribution import Distribution
 
 
-_replicate_cache: dict = {}
-
-
 def place(x, sharding) -> jax.Array:
     """Place a host array under ``sharding``, multi-process safe.
 
@@ -58,12 +55,13 @@ def _relabel(x: jax.Array, sharding) -> jax.Array:
 def _replicate_fn(grid: Grid):
     """Cached jitted identity with fully-replicated output sharding (one
     compile per mesh, not per to_global call)."""
-    key = grid.cache_key
-    if key not in _replicate_cache:
-        _replicate_cache[key] = jax.jit(
-            lambda v: v, out_shardings=grid.replicated_sharding()
-        )
-    return _replicate_cache[key]
+    from dlaf_tpu.plan import core as _plan
+
+    return _plan.cached(
+        "replicate",
+        (grid.cache_key,),
+        lambda: jax.jit(lambda v: v, out_shardings=grid.replicated_sharding()),
+    )
 
 
 class DistributedMatrix:

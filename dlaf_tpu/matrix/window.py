@@ -30,8 +30,7 @@ from dlaf_tpu.comm import collectives as coll
 from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import DistributedMatrix
-
-_cache: dict = {}
+from dlaf_tpu.plan import core as _plan
 
 
 def _reshard_rolled(data, src_grid, dst_grid, roll):
@@ -45,13 +44,15 @@ def _reshard_rolled(data, src_grid, dst_grid, roll):
     from dlaf_tpu.matrix.matrix import _relabel
 
     sr, sc = roll
-    key = ("reshard", src_grid.cache_key, roll, data.shape, str(data.dtype))
-    if key not in _cache:
-        _cache[key] = jax.jit(
+    fn = _plan.cached(
+        "window_reshard",
+        (src_grid.cache_key, roll, data.shape, str(data.dtype)),
+        lambda: jax.jit(
             lambda x: jnp.roll(x, (sr, sc), (0, 1)),
             out_shardings=src_grid.stacked_sharding(),
-        )
-    return _relabel(_cache[key](data), dst_grid.stacked_sharding())
+        ),
+    )
+    return _relabel(fn(data), dst_grid.stacked_sharding())
 
 
 def _axis_extract(x, *, axis, a, d, lt_out, n_out, nt_parent):
@@ -208,8 +209,7 @@ def window_extract(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
     if m == 0 or n == 0:
         return DistributedMatrix.zeros(mat.grid, (m, n), tuple(mat.dist.block_size), mat.dtype)
     mb, nb = mat.dist.block_size
-    key = ("wext", mat.grid.cache_key, mat.dist, r0, c0, m, n)
-    if key not in _cache:
+    def build():
         kern = partial(
             _extract_kernel,
             a_r=r0 // mb, d_r=r0 % mb, a_c=c0 // nb, d_c=c0 % nb,
@@ -217,8 +217,12 @@ def window_extract(mat: DistributedMatrix, origin, size) -> DistributedMatrix:
             m_out=m, n_out=n,
             mt_par=mat.dist.nr_tiles.rows, nt_par=mat.dist.nr_tiles.cols,
         )
-        _cache[key] = coll.spmd(mat.grid, kern)
-    return DistributedMatrix(out_dist, mat.grid, _cache[key](mat.data))
+        return coll.spmd(mat.grid, kern)
+
+    fn = _plan.cached(
+        "window_extract", (mat.grid.cache_key, mat.dist, r0, c0, m, n), build
+    )
+    return DistributedMatrix(out_dist, mat.grid, fn(mat.data))
 
 
 def window_update(mat: DistributedMatrix, origin, win: DistributedMatrix) -> DistributedMatrix:
@@ -275,8 +279,7 @@ def window_update(mat: DistributedMatrix, origin, win: DistributedMatrix) -> Dis
     if m == 0 or n == 0:
         return mat
     mb, nb = mat.dist.block_size
-    key = ("wupd", mat.grid.cache_key, mat.dist, win.dist, r0, c0)
-    if key not in _cache:
+    def build():
         kern = partial(
             _update_kernel,
             a_r=r0 // mb, d_r=r0 % mb, a_c=c0 // nb, d_c=c0 % nb,
@@ -284,5 +287,9 @@ def window_update(mat: DistributedMatrix, origin, win: DistributedMatrix) -> Dis
             mt_win=win.dist.nr_tiles.rows, nt_win=win.dist.nr_tiles.cols,
             ltr_mid=mat.dist.local_slots.rows,
         )
-        _cache[key] = coll.spmd(mat.grid, kern, donate_argnums=(0,))
-    return mat._inplace(_cache[key](mat.data, win.data))
+        return coll.spmd(mat.grid, kern, donate_argnums=(0,))
+
+    fn = _plan.cached(
+        "window_update", (mat.grid.cache_key, mat.dist, win.dist, r0, c0), build
+    )
+    return mat._inplace(fn(mat.data, win.data))

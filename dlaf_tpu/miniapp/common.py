@@ -16,53 +16,13 @@ import jax
 import numpy as np
 
 # persistent XLA compilation cache: repeated miniapp/bench invocations skip
-# recompiles (the reference has no analogue; compiles are XLA's one-time cost).
-# Partitioned by (platform, forced host device count, host CPU fingerprint):
-# deserializing an executable cached under a different device topology can
-# SEGFAULT inside backend.deserialize_executable, and an XLA:CPU AOT blob
-# compiled on a host with different ISA features loads with a SIGILL warning
-# — configurations/machines must never share a dir.
-# DLAF_TPU_COMPILE_CACHE="" disables the persistent cache entirely.
-import re as _re
+# recompiles (the reference has no analogue; compiles are XLA's one-time
+# cost).  The wiring lives in tune.setup_compile_cache (partitioned dirs,
+# env DLAF_TPU_COMPILE_CACHE / _MIN_S); only the miniapp harness turns it
+# on by DEFAULT — the library path stays env-opt-in.
+from dlaf_tpu import tune as _tune
 
-_cache_base = os.environ.get(
-    "DLAF_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/dlaf_tpu_xla")
-)
-if _cache_base:
-    _plat = (os.environ.get("JAX_PLATFORMS") or "default").replace(",", "-")
-    _m = _re.search(
-        r"host_platform_device_count=(\d+)", os.environ.get("XLA_FLAGS", "")
-    )
-
-    def _host_fingerprint() -> str:
-        """Short hash of the host's CPU feature flags (ISA compatibility).
-        x86 cpuinfo says 'flags', aarch64 says 'Features'; if neither
-        appears, hash the whole cpuinfo rather than degrade to a constant."""
-        import hashlib
-
-        try:
-            with open("/proc/cpuinfo") as f:
-                txt = f.read()
-            for line in txt.splitlines():
-                if line.startswith(("flags", "Features")):
-                    return hashlib.sha1(line.encode()).hexdigest()[:8]
-            return hashlib.sha1(txt.encode()).hexdigest()[:8]
-        except OSError:
-            import platform
-
-            return hashlib.sha1(
-                f"{platform.machine()}-{platform.processor()}".encode()
-            ).hexdigest()[:8]
-
-    _cache_dir = os.path.join(
-        _cache_base,
-        f"{_plat}-{_m.group(1) if _m else 1}-{_host_fingerprint()}",
-    )
-    try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+_tune.setup_compile_cache(default_base="~/.cache/dlaf_tpu_xla")
 
 from dlaf_tpu.common.nativebuild import honor_jax_platforms_env
 

@@ -25,9 +25,11 @@ dump pointers, ``obs.flight``) kinds; ``/3`` adds the ``scenario``
 (scenario-run results and replay verdicts, ``dlaf_tpu.scenario``) and
 ``capacity`` (service-time fits and replicas-needed predictions,
 ``scenario.capacity``) kinds, and stamps ``gw.request`` root spans with
-the replayable request attrs (shape, dtype, deadline, batch group key).
-Writers stamp ``/3``; readers (:func:`validate_record`,
-:func:`read_jsonl`) accept all three so old BENCH and metrics artifacts
+the replayable request attrs (shape, dtype, deadline, batch group key);
+``/4`` adds the ``plan`` kind (unified executable-plan cache events —
+hit/miss/build/evict/warmup/decision, ``dlaf_tpu.plan``).
+Writers stamp ``/4``; readers (:func:`validate_record`,
+:func:`read_jsonl`) accept all four so old BENCH and metrics artifacts
 keep parsing.
 """
 from __future__ import annotations
@@ -38,9 +40,10 @@ import sys
 import threading
 import time
 
-SCHEMA = "dlaf_tpu.obs/3"
-#: every schema tag a reader accepts (old artifacts carry /1 or /2).
-SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2", "dlaf_tpu.obs/3")
+SCHEMA = "dlaf_tpu.obs/4"
+#: every schema tag a reader accepts (old artifacts carry /1 - /3).
+SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2", "dlaf_tpu.obs/3",
+           "dlaf_tpu.obs/4")
 
 #: kind -> payload fields every record of that kind must carry.
 REQUIRED_FIELDS: dict = {
@@ -62,6 +65,8 @@ REQUIRED_FIELDS: dict = {
     # /3 additions:
     "scenario": ("event",),
     "capacity": ("event",),
+    # /4 additions:
+    "plan": ("event",),
 }
 
 _emitter = None

@@ -212,7 +212,11 @@ def contract(subscripts, a, b, tier: str | None = None):
         tier = resolved_gemm_precision()
     dtype = jnp.result_type(a, b)
     if tier == "auto":
-        tier = _auto_tier(subscripts, a, b, dtype)
+        from dlaf_tpu.plan import autotune
+
+        # a loaded sweep profile may pin the tier; trace-safety holds
+        # because the profile fingerprint is part of every plan key
+        tier = autotune.gemm_tier_override() or _auto_tier(subscripts, a, b, dtype)
     nslices = _SPLIT_SLICES.get(tier)
     if (
         nslices is None

@@ -1,0 +1,225 @@
+"""Analytical parameter choice per geometry, with a measured-profile override.
+
+tritonBLAS-style (arXiv:2512.04226): the launch parameters for a given
+(op, n, dtype, mesh) are picked by closed-form rules from shape and
+backend alone — no per-request search, no warm-up probing.  The rules
+below are exactly the hand-tuned defaults the drivers shipped with
+(serve's ``min(128, n)`` block, Grid.create's most-square factorization,
+collectives 'auto' = v2-on-accelerator/psum-on-CPU, batch-sharding below
+``tune.serve_batch_shard_max_n``, the split-GEMM dtype/extent rule), so
+with no profile loaded every decision is bit-identical to the pre-plan
+code — the analytical model is a *refactor* of those scattered branches
+into one consultable place.
+
+Where the model is wrong for a geometry, an offline measured sweep
+(``python -m dlaf_tpu.plan.sweep``, TVM-style: arXiv:2310.20347) persists
+a JSON profile; :func:`load_profile` (called by ``tune.initialize`` from
+env ``DLAF_TPU_PLAN_PROFILE``) installs it and every rule defers to a
+matching entry.  The profile's fingerprint joins ``plan.trace_suffix`` —
+loading or swapping a profile retraces rather than aliasing executables
+chosen under different parameters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+PROFILE_SCHEMA = "dlaf_tpu.plan.profile/1"
+
+_profile: dict | None = None
+_fingerprint: str | None = None
+
+
+# ---------------------------------------------------------------- profile
+
+
+def load_profile(path: str | None = None):
+    """Install the measured-sweep profile at ``path`` (default: env
+    ``DLAF_TPU_PLAN_PROFILE``; empty/unset clears any loaded profile).
+    Returns the profile dict or None.  Bad files raise
+    ``health.ConfigurationError`` — a typo'd profile path must not
+    silently fall back to analytic choices."""
+    global _profile, _fingerprint
+    if path is None:
+        path = os.environ.get("DLAF_TPU_PLAN_PROFILE", "")
+    if not path:
+        _profile, _fingerprint = None, None
+        return None
+    from dlaf_tpu.health import ConfigurationError
+
+    try:
+        with open(path) as fh:
+            prof = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ConfigurationError(
+            f"plan profile {path!r} unreadable: {e} (env DLAF_TPU_PLAN_PROFILE)"
+        ) from e
+    if not isinstance(prof, dict) or prof.get("schema") != PROFILE_SCHEMA:
+        raise ConfigurationError(
+            f"plan profile {path!r}: schema {prof.get('schema') if isinstance(prof, dict) else type(prof).__name__!r} "
+            f"!= {PROFILE_SCHEMA!r}"
+        )
+    _profile = prof
+    _fingerprint = hashlib.sha1(
+        json.dumps(prof, sort_keys=True).encode()
+    ).hexdigest()[:10]
+    return prof
+
+
+def clear_profile() -> None:
+    global _profile, _fingerprint
+    _profile, _fingerprint = None, None
+
+
+def profile() -> dict | None:
+    return _profile
+
+
+def profile_fingerprint() -> str | None:
+    """Short content hash of the loaded profile (None = analytic-only).
+    Part of ``plan.trace_suffix``: parameter choices are trace state."""
+    return _fingerprint
+
+
+def _entry(op: str, n: int, dtype) -> dict | None:
+    """Exact-match profile entry for (op, n, dtype), or None."""
+    if _profile is None:
+        return None
+    import numpy as np
+
+    ds = np.dtype(dtype).str
+    for e in _profile.get("entries", ()):
+        if e.get("op") == op and int(e.get("n", -1)) == int(n) \
+                and e.get("dtype") == ds:
+            return e
+    return None
+
+
+def _auto_override(knob: str):
+    """Profile-global override for an 'auto' tune knob (profile ``auto``
+    section), or None."""
+    if _profile is None:
+        return None
+    return _profile.get("auto", {}).get(knob)
+
+
+# ------------------------------------------------------- analytical rules
+
+
+def block_size(op: str, n: int, dtype="float32") -> int:
+    """Tile size ``nb`` for a bucket of order ``n``: profile entry when
+    present, else the serve default ``min(128, n)`` (128 keeps tiles
+    MXU-shaped while small buckets stay single-tile)."""
+    e = _entry(op, n, dtype)
+    if e and "nb" in e.get("choice", {}):
+        return int(e["choice"]["nb"])
+    return min(128, int(n))
+
+
+def grid_shape(ndevices: int) -> tuple:
+    """Most-square ``(Pr, Pc)`` factorization with ``Pr <= Pc`` — the
+    Grid.create default, stated once more here so sweeps can score
+    alternatives against it."""
+    import numpy as np
+
+    n = int(ndevices)
+    pr = int(np.floor(np.sqrt(n)))
+    while n % pr:
+        pr -= 1
+    return (pr, n // pr)
+
+
+def collectives_tier(backend: str | None = None) -> str:
+    """Resolution of ``tune.collectives_impl == 'auto'``: profile override
+    when present (a measured sweep may promote pallas — the explicit
+    measurement the tier was gated on), else v2 on accelerator backends,
+    psum on CPU (where the masked all-reduce benchmarks at parity)."""
+    o = _auto_override("collectives_impl")
+    if o is not None:
+        from dlaf_tpu.tune import validate_collectives_impl
+
+        validate_collectives_impl(o)
+        return o
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return "v2" if backend != "cpu" else "psum"
+
+
+def shard_batch(op: str, n: int, dtype="float32") -> bool:
+    """Serve mesh mode for order ``n``: batch-sharded below
+    ``tune.serve_batch_shard_max_n`` (one element per device, collectives
+    degenerate), matrix-sharded above; profile entry overrides."""
+    e = _entry(op, n, dtype)
+    if e and "shard_batch" in e.get("choice", {}):
+        return bool(e["choice"]["shard_batch"])
+    from dlaf_tpu.tune import get_tune_parameters
+
+    return int(n) <= int(get_tune_parameters().serve_batch_shard_max_n)
+
+
+def gemm_tier_override() -> str | None:
+    """Profile-global override consulted by ``ops.tile.contract`` when
+    ``gemm_precision == 'auto'`` (None = keep the per-site analytical
+    rule: split only on accelerators with contracted extent >=
+    ``tile.AUTO_SPLIT_MIN_K``, tier by dtype width)."""
+    o = _auto_override("gemm_precision")
+    if o is None or o == "auto":
+        return None
+    from dlaf_tpu.tune import validate_gemm_precision
+
+    validate_gemm_precision(o)
+    return o
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One geometry's resolved launch parameters and their provenance."""
+
+    op: str
+    n: int
+    dtype: str
+    nb: int
+    grid: tuple
+    collectives: str
+    shard_batch: bool
+    gemm_precision: str
+    source: str  # 'analytic' | 'profile'
+
+
+def decide(op: str, n: int, dtype="float32", *, ndevices: int | None = None,
+           backend: str | None = None) -> Decision:
+    """The full parameter choice for one geometry (the consultable face of
+    the model; the serve drivers read the individual rules directly on
+    their hot paths).  Emits a ``plan`` ``decision`` event when a metrics
+    sink is active."""
+    import numpy as np
+
+    from dlaf_tpu.obs import metrics as om
+    from dlaf_tpu.tune import get_tune_parameters
+
+    if ndevices is None:
+        import jax
+
+        ndevices = jax.device_count()
+    p = get_tune_parameters()
+    gp = p.gemm_precision
+    if gp == "auto":
+        gp = gemm_tier_override() or "auto"
+    coll = p.collectives_impl
+    if coll == "auto":
+        coll = collectives_tier(backend)
+    d = Decision(
+        op=op, n=int(n), dtype=np.dtype(dtype).str,
+        nb=block_size(op, n, dtype),
+        grid=grid_shape(ndevices),
+        collectives=coll,
+        shard_batch=shard_batch(op, n, dtype),
+        gemm_precision=gp,
+        source="profile" if _entry(op, n, dtype) else "analytic",
+    )
+    om.emit("plan", event="decision", **dataclasses.asdict(d))
+    return d

@@ -1,0 +1,152 @@
+"""Offline measured sweep: ``python -m dlaf_tpu.plan.sweep``.
+
+TVM-style complement to the analytical model (arXiv:2310.20347): for each
+(op, bucket, dtype) geometry, time the real serve executables over a small
+candidate ladder of tile sizes (and optionally the collectives tiers) and
+persist the winners as a JSON profile.  ``tune.initialize`` loads the
+profile from env ``DLAF_TPU_PLAN_PROFILE`` and every
+``plan.autotune`` rule defers to a matching entry — the sweep only has to
+cover the geometries the closed-form rules get wrong.
+
+The profile records every candidate's timing, not just the winner, so a
+reviewer can see the margin; profiles are per (backend, device_count) and
+stamp both for sanity checks at load sites.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _candidates(n: int, nbs) -> list:
+    if nbs:
+        return sorted({min(int(v), n) for v in nbs})
+    return sorted({min(32, n), min(64, n), min(128, n)})
+
+
+def _time_op(op: str, n: int, dtype, nb: int, batch: int, repeat: int, cache):
+    import numpy as np
+
+    from dlaf_tpu.serve import batched
+
+    rng = np.random.default_rng(17)
+    base = rng.standard_normal((batch, n, n)).astype(dtype)
+    spd = base @ np.swapaxes(base, -1, -2) + n * np.eye(n, dtype=dtype)
+    rhs = np.ones((batch, n, 1), dtype)
+
+    def run():
+        if op == "potrf":
+            batched.batched_cholesky_factorization("L", spd, block_size=nb,
+                                                   cache=cache)
+        elif op == "posv":
+            batched.batched_positive_definite_solver("L", spd, rhs,
+                                                     block_size=nb, cache=cache)
+        else:
+            batched.batched_eigensolver("L", spd, cache=cache)
+
+    run()  # compile outside the timed region
+    best = float("inf")
+    for _ in range(max(1, repeat)):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def sweep(ops, ns, dtypes, *, nbs=(), batch=4, repeat=2,
+          collectives=(), out=None, verbose=True) -> dict:
+    """Run the sweep and return (and optionally write) the profile dict."""
+    import jax
+    import numpy as np
+
+    from dlaf_tpu import tune
+    from dlaf_tpu.plan import autotune
+    from dlaf_tpu.serve import bucketing
+
+    entries = []
+    for dtype in dtypes:
+        dt = np.dtype(dtype)
+        for n in ns:
+            n = int(n)
+            for op in ops:
+                cache = bucketing.CompiledCache(capacity=64)
+                cands = []
+                # eigh's dense executable has no tile blocking: one candidate
+                for nb in ([n] if op == "eigh" else _candidates(n, nbs)):
+                    s = _time_op(op, n, dt, nb, batch, repeat, cache)
+                    cands.append({"nb": nb, "seconds": s})
+                    if verbose:
+                        print(f"sweep: {op} n={n} {dt.str} nb={nb}: {s:.4f}s")
+                best = min(cands, key=lambda c: c["seconds"])
+                entries.append({
+                    "op": op, "n": n, "dtype": dt.str,
+                    "choice": {"nb": best["nb"],
+                               "shard_batch": autotune.shard_batch(op, n, dt)},
+                    "seconds": best["seconds"], "candidates": cands,
+                })
+    prof = {
+        "schema": autotune.PROFILE_SCHEMA,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "entries": entries,
+    }
+    if collectives:
+        # score the tiers over the whole ladder; the winner becomes the
+        # profile-global resolution of collectives_impl='auto'
+        times = {}
+        n_top = max(int(v) for v in ns)
+        for tier in collectives:
+            tune.validate_collectives_impl(tier)
+            prev = tune.get_tune_parameters().collectives_impl
+            tune.get_tune_parameters().update(collectives_impl=tier)
+            try:
+                cache = bucketing.CompiledCache(capacity=64)
+                times[tier] = _time_op("potrf", n_top, np.dtype(dtypes[0]),
+                                       min(128, n_top), batch, repeat, cache)
+            finally:
+                tune.get_tune_parameters().update(collectives_impl=prev)
+            if verbose:
+                print(f"sweep: collectives={tier} n={n_top}: {times[tier]:.4f}s")
+        prof["auto"] = {"collectives_impl": min(times, key=times.get)}
+        prof["collectives_times"] = times
+    if out:
+        with open(out, "w") as fh:
+            json.dump(prof, fh, indent=1, sort_keys=True)
+        if verbose:
+            print(f"sweep: profile written to {out} "
+                  f"({len(entries)} entries)")
+    return prof
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="measured autotune sweep -> JSON profile "
+                    "(load via DLAF_TPU_PLAN_PROFILE)")
+    p.add_argument("--ops", default="potrf,posv")
+    p.add_argument("--ns", default="", help="comma-separated bucket orders "
+                   "(default: tune.serve_buckets)")
+    p.add_argument("--dtypes", default="float32")
+    p.add_argument("--nbs", default="", help="tile-size candidates "
+                   "(default: 32,64,128 clamped to n)")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--repeat", type=int, default=2)
+    p.add_argument("--collectives", default="", help="also score these "
+                   "collectives tiers (e.g. psum,v2) into the profile's "
+                   "'auto' section")
+    p.add_argument("--out", default="plan_profile.json")
+    args = p.parse_args(argv)
+
+    from dlaf_tpu.serve import bucketing
+
+    split = lambda s: tuple(v.strip() for v in s.split(",") if v.strip())
+    ns = tuple(int(v) for v in split(args.ns)) or bucketing.bucket_table()
+    sweep(split(args.ops), ns, split(args.dtypes),
+          nbs=tuple(int(v) for v in split(args.nbs)),
+          batch=args.batch, repeat=args.repeat,
+          collectives=split(args.collectives), out=args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

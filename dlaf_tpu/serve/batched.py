@@ -53,6 +53,8 @@ from dlaf_tpu.matrix import layout
 from dlaf_tpu.matrix.distribution import Distribution
 from dlaf_tpu.matrix.matrix import place
 from dlaf_tpu.ops import tile as t
+from dlaf_tpu.plan import autotune as _autotune
+from dlaf_tpu.plan import core as _plan
 from dlaf_tpu.serve import bucketing
 
 P = jax.sharding.PartitionSpec
@@ -78,36 +80,33 @@ def _default_grid() -> Grid:
     return _default_grid_box[0]
 
 
-_mesh_cache: dict = {}
-
-
 def _mesh3(grid: Grid, shard_batch: bool):
     """3-axis mesh over the grid's devices: ``(ndev, 1, 1)`` in batch mode,
     ``(1, Pr, Pc)`` in matrix mode.  Built raw (Grid only admits 2-axis
     ('r','c') meshes); the kernels resolve 'r'/'c' by name as usual."""
-    key = (grid.cache_key, bool(shard_batch))
-    if key not in _mesh_cache:
+
+    def build():
         devs = grid.mesh.devices
         shape = (devs.size, 1, 1) if shard_batch else (1,) + devs.shape
-        _mesh_cache[key] = jax.sharding.Mesh(
+        return jax.sharding.Mesh(
             devs.reshape(shape), (BATCH_AXIS, ROW_AXIS, COL_AXIS)
         )
-    return _mesh_cache[key]
 
-
-_gather_cache: dict = {}
+    return _plan.cached("serve_mesh3", (grid.cache_key, bool(shard_batch)), build)
 
 
 def _gather(mesh, *arrs):
     """Fetch device results to host numpy, multi-process safe (replicate
     across the mesh inside jit, then read local shards — the to_global()
     pattern)."""
-    key = tuple(int(d.id) for d in mesh.devices.flat)
-    if key not in _gather_cache:
-        _gather_cache[key] = jax.jit(
+    fn = _plan.cached(
+        "serve_gather",
+        tuple(int(d.id) for d in mesh.devices.flat),
+        lambda: jax.jit(
             lambda *v: v, out_shardings=jax.sharding.NamedSharding(mesh, P())
-        )
-    rep = _gather_cache[key](*arrs)
+        ),
+    )
+    rep = fn(*arrs)
     if jax.process_count() > 1:
         return tuple(np.asarray(r.addressable_data(0)) for r in rep)
     return tuple(np.asarray(jax.device_get(r)) for r in rep)
@@ -175,36 +174,25 @@ def _check_stack(name: str, a, uplo: str):
     return a
 
 
-def _resolve_mode(n: int, shard_batch):
+def _resolve_mode(op: str, n: int, dtype, shard_batch):
+    """Mesh-mode choice: explicit caller value wins, else the autotuner
+    (measured profile entry if one matches, analytic
+    ``n <= tune.serve_batch_shard_max_n`` rule otherwise)."""
     if shard_batch is None:
-        from dlaf_tpu.tune import get_tune_parameters
-
-        return n <= int(get_tune_parameters().serve_batch_shard_max_n)
+        return _autotune.shard_batch(op, n, dtype)
     return bool(shard_batch)
 
 
-def _default_block(n_bucket: int) -> int:
-    return min(128, n_bucket)
+def _default_block(op: str, n_bucket: int, dtype) -> int:
+    """Bucket tile size: the autotuner's measured choice when a profile
+    entry matches, else the analytic ``min(128, n)`` default."""
+    return _autotune.block_size(op, n_bucket, dtype)
 
 
 def _chol_variant() -> str:
     from dlaf_tpu.tune import get_tune_parameters
 
     return "lookahead" if get_tune_parameters().cholesky_lookahead else "bucketed"
-
-
-def _trace_knobs(variant: str) -> tuple:
-    """Trace-time knobs every serve executable key must carry (the same
-    set the single drivers' kernel caches use).  ``trsm_lookahead`` picks
-    the posv solve kernel inside `_build_posv_matrix_exec`; carrying it
-    for every op over-keys potrf/eigh harmlessly but keeps one knob tuple
-    for the whole serve tier (DLAF001)."""
-    from dlaf_tpu.tune import get_tune_parameters
-
-    ratio = _spmd.bucket_ratio() if variant == "bucketed" else None
-    return (variant, ratio, bool(get_tune_parameters().trsm_lookahead),
-            _spmd.trsm_trace_key(), coll.collectives_trace_key(),
-            _spmd.gemm_precision_trace_key())
 
 
 def _dist_for(n_bucket: int, mb: int, grid: Grid, shard_batch: bool, k: int | None = None):
@@ -356,13 +344,15 @@ def batched_cholesky_factorization(uplo, a, grid=None, *, block_size=None,
     grid = grid if grid is not None else _default_grid()
     cache = cache if cache is not None else bucketing.default_cache()
     nb_bucket = bucketing.bucket_for(n)
-    mb = int(block_size) if block_size is not None else _default_block(nb_bucket)
-    shard_batch = _resolve_mode(n, shard_batch)
+    mb = int(block_size) if block_size is not None else _default_block("potrf", nb_bucket, a.dtype)
+    shard_batch = _resolve_mode("potrf", n, a.dtype, shard_batch)
     variant = _chol_variant()
     dist = _dist_for(nb_bucket, mb, grid, shard_batch)
     mesh = _mesh3(grid, shard_batch)
+    # static identity only: trace-time knobs land in the key via the plan
+    # layer's trace_suffix() (variant stays static — it names the kernel)
     key = ("potrf", nb_bucket, np.dtype(a.dtype).str, uplo, mb, shard_batch,
-           grid.cache_key) + _trace_knobs(variant)
+           grid.cache_key, variant)
     fn = cache.get(key, lambda: _build_chol_exec(grid, dist, shard_batch, variant))
 
     bshards = mesh.devices.shape[0]
@@ -408,13 +398,13 @@ def batched_positive_definite_solver(uplo, a, b, grid=None, *, block_size=None,
     grid = grid if grid is not None else _default_grid()
     cache = cache if cache is not None else bucketing.default_cache()
     nb_bucket = bucketing.bucket_for(n)
-    mb = int(block_size) if block_size is not None else _default_block(nb_bucket)
-    shard_batch = _resolve_mode(n, shard_batch)
+    mb = int(block_size) if block_size is not None else _default_block("posv", nb_bucket, a.dtype)
+    shard_batch = _resolve_mode("posv", n, a.dtype, shard_batch)
     variant = _chol_variant()
     dist = _dist_for(nb_bucket, mb, grid, shard_batch)
     mesh = _mesh3(grid, shard_batch)
     key = ("posv", nb_bucket, np.dtype(a.dtype).str, uplo, mb, shard_batch, k,
-           grid.cache_key) + _trace_knobs(variant)
+           grid.cache_key, variant)
 
     bshards = mesh.devices.shape[0]
     bp = _pad_batch_count(bsz, bshards)
@@ -482,8 +472,7 @@ def batched_eigensolver(uplo, a, grid=None, *, shard_batch=None, cache=None):
     cache = cache if cache is not None else bucketing.default_cache()
     nb_bucket = bucketing.bucket_for(n)
     mesh = _mesh3(grid, True)
-    key = ("eigh", nb_bucket, np.dtype(a.dtype).str, grid.cache_key,
-           coll.collectives_trace_key())
+    key = ("eigh", nb_bucket, np.dtype(a.dtype).str, grid.cache_key)
     fn = cache.get(key, lambda: _build_eig_exec(grid))
 
     bshards = mesh.devices.shape[0]

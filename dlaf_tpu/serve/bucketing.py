@@ -13,13 +13,16 @@ request).  Two pieces:
   bucket round up to a multiple of it (open-ended tail, still a bounded
   number of shapes per decade).
 
-* :class:`CompiledCache` — a bounded LRU of compiled executables keyed on
-  the full bucket identity (kind, N, dtype, uplo, mode, and every
-  trace-time knob).  Hits/misses/evictions are counted locally (tests
-  assert on ``counters``) and emitted through ``obs.metrics`` as ``serve``
-  events; builds run under :func:`~dlaf_tpu.serve.context.serving` so any
-  kernel-module cache entries created on the way carry the bucket token in
-  their keys.
+* :class:`CompiledCache` — a bounded LRU *view* over the process-wide
+  :mod:`dlaf_tpu.plan` registry, keyed on the STATIC bucket identity
+  (kind, N, dtype, uplo, mode, grid).  Trace-time knobs are no longer
+  spelled per-site: the underlying ``plan.cached`` call appends
+  ``plan.trace_suffix()`` (collectives/trsm/gemm-precision/serve-token/
+  profile fingerprint) to every key in one place.  Hits/misses/evictions
+  are still counted locally (tests assert on ``counters``) and emitted
+  through ``obs.metrics`` as ``serve`` events; builds run under
+  :func:`~dlaf_tpu.serve.context.serving` so the bucket token lands in the
+  plan key, and evicting an LRU entry evicts the backing plan entry too.
 """
 from __future__ import annotations
 
@@ -28,6 +31,7 @@ import time
 from collections import OrderedDict
 
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.plan import core as _plan
 from dlaf_tpu.serve.context import serving
 
 
@@ -127,7 +131,7 @@ class CompiledCache:
             if key in self._entries:
                 self.counters["hit"] += 1
                 self._entries.move_to_end(key)
-                fn = self._entries[key]
+                fn = self._entries[key][0]
             else:
                 fn = None
                 self.counters["miss"] += 1
@@ -138,8 +142,13 @@ class CompiledCache:
             return fn
         om.emit("serve", event="cache_miss", bucket=bucket_label(key), **labels)
         t0 = time.perf_counter()
+        static = tuple(key) if isinstance(key, tuple) else (key,)
+        # build under the bucket token so the plan key (whose trace suffix
+        # includes serve_trace_key()) and every nested kernel-cache entry
+        # carry the bucket identity
         with serving(key):
-            fn = builder()
+            fn = _plan.cached("serve", static, builder)
+            pkey = _plan.plan_key("serve", static)
         om.emit(
             "serve", event="compile", bucket=bucket_label(key),
             seconds=time.perf_counter() - t0, **labels,
@@ -149,14 +158,15 @@ class CompiledCache:
             if key in self._entries:
                 # lost a build race to another worker: keep the winner
                 self._entries.move_to_end(key)
-                fn = self._entries[key]
+                fn = self._entries[key][0]
             else:
-                self._entries[key] = fn
+                self._entries[key] = (fn, pkey)
             while len(self._entries) > self.capacity:
-                old, _ = self._entries.popitem(last=False)
+                old, (_, old_pkey) = self._entries.popitem(last=False)
                 self.counters["evict"] += 1
-                evicted.append(old)
-        for old in evicted:
+                evicted.append((old, old_pkey))
+        for old, old_pkey in evicted:
+            _plan.evict(old_pkey)
             om.emit("serve", event="cache_evict", bucket=bucket_label(old),
                     **key_labels(old))
         return fn

@@ -387,6 +387,46 @@ def summarize(path: str) -> int:
                 print("   failover: "
                       + "  ".join(f"{e}={n}" for e, n in sorted(fo.items())))
 
+    plan = by_kind.get("plan", [])
+    if plan:
+        counts = defaultdict(int)
+        for r in plan:
+            counts[r["event"]] += 1
+        hits, misses = counts.get("hit", 0), counts.get("miss", 0)
+        rate = hits / (hits + misses) if hits + misses else 0.0
+        builds = [r for r in plan if r["event"] == "build"]
+        compiled = sum(int(r.get("compiles", 0)) for r in builds)
+        aot = sum(int(r.get("aot_loads", 0)) for r in builds)
+        bsecs = sum(float(r.get("seconds", 0.0)) for r in builds)
+        print(f"-- plan ({len(plan)} events):")
+        print(f"   registry: {hits} hits / {misses} misses "
+              f"({100 * rate:.0f}% hit rate), {counts.get('evict', 0)} evictions")
+        print(f"   builds: {len(builds)} in {bsecs:.2f}s — "
+              f"{compiled} backend compiles, {aot} AOT loads"
+              + ("  [zero-compile]" if builds and not compiled else ""))
+        warm = [r for r in plan if r["event"] == "warmup"]
+        if warm:
+            wc = sum(int(r.get("compiles", 0)) for r in warm)
+            wa = sum(int(r.get("aot_loads", 0)) for r in warm)
+            ws = sum(float(r.get("seconds", 0.0)) for r in warm)
+            print(f"   warmup: {len(warm)} plans in {ws:.2f}s — "
+                  f"{wc} compiles, {wa} AOT loads")
+            print(f"   {'op':>8s} {'n':>6s} {'dtype':>6s} {'seconds':>8s} "
+                  f"{'compiles':>9s} {'aot':>5s}")
+            for r in warm:
+                print(f"   {r.get('op', '?'):>8s} {r.get('n', '?')!s:>6s} "
+                      f"{r.get('dtype', '?'):>6s} "
+                      f"{float(r.get('seconds', 0.0)):8.2f} "
+                      f"{int(r.get('compiles', 0)):9d} "
+                      f"{int(r.get('aot_loads', 0)):5d}")
+        decs = [r for r in plan if r["event"] == "decision"]
+        if decs:
+            src = defaultdict(int)
+            for r in decs:
+                src[r.get("source", "?")] += 1
+            print(f"   autotune decisions: {len(decs)} ("
+                  + ", ".join(f"{s} x{n}" for s, n in sorted(src.items())) + ")")
+
     for r in by_kind.get("scenario", []):
         if r["event"] == "result":
             counts = r.get("counts", {})

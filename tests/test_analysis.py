@@ -68,6 +68,11 @@ def _real_tree_project(mutate_rel=None, mutate=None):
 # ------------------------------------------------------- DLAF001 cache keys
 
 
+def _knob_findings(findings):
+    """Key-coverage findings only (drop the module-dict-placement ones)."""
+    return [f for f in findings if "module-level cache dict" not in f.message]
+
+
 def test_dlaf001_dict_store_flags_missing_knob():
     proj = _project({"dlaf_tpu/algorithms/fact.py": """
         from dlaf_tpu.tune import get_tune_parameters
@@ -84,7 +89,7 @@ def test_dlaf001_dict_store_flags_missing_knob():
                 _kernel_cache[key] = _build(n)
             return _kernel_cache[key]
     """})
-    findings = cache_keys.check(proj)
+    findings = _knob_findings(cache_keys.check(proj))
     assert len(findings) == 1
     f = findings[0]
     assert f.rule == "DLAF001" and f.symbol == "factor"
@@ -113,7 +118,7 @@ def test_dlaf001_complete_key_and_derived_elements_are_clean():
                 _kernel_cache[key] = _build(n)
             return _kernel_cache[key]
     """})
-    assert cache_keys.check(proj) == []
+    assert _knob_findings(cache_keys.check(proj)) == []
 
 
 def test_dlaf001_compiled_cache_builder_only_reads():
@@ -147,7 +152,81 @@ def test_dlaf001_sentinel_stores_ignored():
             _fail_cache[(n,)] = True
             return w
     """})
+    assert _knob_findings(cache_keys.check(proj)) == []
+
+
+def test_dlaf001_module_level_cache_dict_outside_plan_flagged():
+    """A new ad-hoc module-level cache dict is a finding in its own right:
+    the plan registry is the single audited cache site."""
+    proj = _project({"dlaf_tpu/algorithms/fact.py": """
+        _kernel_cache = {}
+
+        def noop():
+            return None
+    """})
+    findings = cache_keys.check(proj)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "DLAF001" and f.symbol == "_kernel_cache"
+    assert "module-level cache dict" in f.message
+    assert "dlaf_tpu.plan.cached" in f.message
+
+
+def test_dlaf001_module_level_cache_dict_inside_plan_exempt():
+    proj = _project({"dlaf_tpu/plan/core.py": """
+        _cache = {}
+
+        def noop():
+            return None
+    """})
     assert cache_keys.check(proj) == []
+
+
+def test_dlaf001_plan_cached_flags_missing_static_knob():
+    """plan form: a knob read under the builder that is neither in the
+    static key nor in trace_suffix() must be flagged."""
+    proj = _project({"dlaf_tpu/algorithms/fact.py": """
+        from dlaf_tpu.tune import get_tune_parameters
+        from dlaf_tpu.plan import core as _plan
+
+        def factor(n):
+            def build():
+                p = get_tune_parameters()
+                return ("exe", n, p.lookahead)
+            key = (n, get_tune_parameters().panel_width)
+            return _plan.cached("factor", key, build)
+    """})
+    findings = _knob_findings(cache_keys.check(proj))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "DLAF001" and f.symbol == "factor"
+    assert "lookahead" in f.message and "panel_width" not in f.message
+
+
+def test_dlaf001_plan_cached_suffix_covers_ambient_knobs():
+    """Knobs carried by plan.core.trace_suffix() need not appear in the
+    per-site static key — that is the point of the unification."""
+    proj = _project({
+        "dlaf_tpu/plan/core.py": """
+            from dlaf_tpu.tune import get_tune_parameters
+
+            def trace_suffix():
+                p = get_tune_parameters()
+                return (bool(p.lookahead),)
+        """,
+        "dlaf_tpu/algorithms/fact.py": """
+            from dlaf_tpu.tune import get_tune_parameters
+            from dlaf_tpu.plan import core as _plan
+
+            def factor(n):
+                def build():
+                    p = get_tune_parameters()
+                    return ("exe", n, p.lookahead)
+                key = (n,)
+                return _plan.cached("factor", key, build)
+        """,
+    })
+    assert _knob_findings(cache_keys.check(proj)) == []
 
 
 # ------------------------------------------- DLAF002 collective symmetry
@@ -481,12 +560,12 @@ def test_parse_errors_become_dlaf000(tmp_path):
 
 
 def test_reverted_bug_dlaf001_trsm_lookahead_key_omission():
-    """Deleting the trsm_lookahead element from the serve knob tuple must
-    reproduce exactly the finding this PR's fix closed."""
+    """Deleting the trsm_lookahead element from plan.core.trace_suffix()
+    must re-open the dead-knob hole at every cache site at once — the
+    serve posv executable is the historical instance of this bug class."""
     proj = _real_tree_project(
-        "dlaf_tpu/serve/batched.py",
-        lambda text: text.replace(
-            "bool(get_tune_parameters().trsm_lookahead),\n            ", ""),
+        "dlaf_tpu/plan/core.py",
+        lambda text: text.replace("bool(p.trsm_lookahead),", "", 1),
     )
     findings = [f for f in cache_keys.check(proj)
                 if f.path == "dlaf_tpu/serve/batched.py"

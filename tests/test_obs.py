@@ -271,13 +271,14 @@ def test_potrf_modeled_wire_bytes_drop_under_v2(grid_2x4, tmp_path):
     kernel is a one-contributor redistribution, so the ring model halves).
     Asserted on the emitted metrics JSONL, not just the in-process dict."""
     from dlaf_tpu.algorithms import cholesky as C
+    from dlaf_tpu.plan import core as plan_core
 
     a = np.tril(tu.random_hermitian_pd(24, np.float32, seed=9))
 
     def wire_total(impl, path):
         # accounting records at TRACE time: drop cached executables so the
         # kernel actually retraces under this impl
-        C._kernel_cache.clear()
+        plan_core.reset()
         om.enable(path)
         ocomms.start()
         with _collectives_impl(impl):

@@ -240,12 +240,13 @@ def test_gemm_precision_flips_compiled_cache_keys(grid_2x4):
         mat_c = DistributedMatrix.from_global(grid_2x4, np.zeros((m, m), np.float32), (mb, mb))
         mul.general_multiplication("N", "N", 1.0, mat_a, mat_b, 0.0, mat_c)
 
+    from dlaf_tpu.plan import core as plan_core
+
     tune.get_tune_parameters().update(gemm_precision="default")
     run()
-    keys_default = set(mul._cache) | set(mul._local_cache)
-    assert any("default" in k for k in keys_default)
+    keys_default = set(plan_core.keys())
+    assert any("default" in str(k) for k in keys_default)
     tune.get_tune_parameters().update(gemm_precision="bf16x3")
     run()
-    keys_after = set(mul._cache) | set(mul._local_cache)
-    new = keys_after - keys_default
-    assert new and all("bf16x3" in k for k in new)
+    new = set(plan_core.keys()) - keys_default
+    assert new and all("bf16x3" in str(k) for k in new)

@@ -41,11 +41,9 @@ def _drop_range_kernels():
     accumulated executables are the memory ceiling (see conftest's
     compile-cache note)."""
     yield
-    from dlaf_tpu.algorithms import cholesky as _c
-    from dlaf_tpu.algorithms import reduction_to_band as _r
+    from dlaf_tpu.plan import core as plan_core
 
-    _c._range_cache.clear()
-    _r._range_cache.clear()
+    plan_core.reset()
 
 
 def _mat(grid, a, mb=MB):

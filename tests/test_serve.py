@@ -70,13 +70,14 @@ def test_bucket_policy():
 
 
 def test_serving_token_scopes_trace_key():
-    from dlaf_tpu.algorithms import _spmd
+    from dlaf_tpu.plan import core as plan_core
     from dlaf_tpu.serve.context import serve_trace_key, serving
 
     assert serve_trace_key() is None
     with serving(("potrf", 256)):
         assert serve_trace_key() == ("potrf", 256)
-        assert _spmd.serve_trace_key() == ("potrf", 256)
+        # the plan layer folds the token into every key via trace_suffix
+        assert ("potrf", 256) in plan_core.trace_suffix()
         with serving("inner"):
             assert serve_trace_key() == "inner"
         assert serve_trace_key() == ("potrf", 256)
@@ -91,14 +92,14 @@ def test_serving_token_scopes_trace_key():
 def test_serve_trace_knobs_carry_trsm_lookahead():
     """DLAF001 regression: ``trsm_lookahead`` selects the posv matrix-mode
     solve kernel inside the cached builder, so the serve executable key
-    must separate the two variants — with the knob outside the key, a
-    runtime flip silently replayed the stale executable."""
-    from dlaf_tpu.serve import batched
+    must separate the two variants — the knob now rides every key via the
+    plan layer's ambient trace suffix instead of a per-site knob tuple."""
+    from dlaf_tpu.plan import core as plan_core
 
     with _tuned(trsm_lookahead=True):
-        on = batched._trace_knobs("bucketed")
+        on = plan_core.trace_suffix()
     with _tuned(trsm_lookahead=False):
-        off = batched._trace_knobs("bucketed")
+        off = plan_core.trace_suffix()
     assert on != off
 
 

@@ -221,7 +221,7 @@ def test_schema_all_versions_validate():
     for tag in om.SCHEMAS:
         om.validate_record({"schema": tag, **base})
     with pytest.raises(ValueError, match="bad schema tag"):
-        om.validate_record({"schema": "dlaf_tpu.obs/4", **base})
+        om.validate_record({"schema": "dlaf_tpu.obs/99", **base})
     om.validate_record({
         "schema": "dlaf_tpu.obs/2", "ts": 0.0, "rank": 0, "kind": "span",
         "name": "x", "trace_id": "t", "span_id": "s", "t0_s": 0.0, "dur_s": 0.1,
