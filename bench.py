@@ -347,6 +347,19 @@ class _Child:
                 self._note(f"posv bf16x3 failed: {type(e).__name__}: {e}")
         else:
             self._note(f"posv bf16x3 skipped: {self.t_left():.0f}s left")
+        # fused trailing-update A/B (f32 — before the x64 flip): lookahead
+        # POTRF with trailing_update_impl='fused' vs 'xla', bit parity
+        # asserted beside both timings (on the CPU mesh the fused leg runs
+        # the interpret-mode consume ring, so only parity + the overlap
+        # model are meaningful; the throughput A/B is tpu_day stage 5h)
+        if self.t_left() > 150:
+            try:
+                self.rec["potrf_fused_trailing"] = self._time_potrf_fused_trailing(2048)
+                self._flush()
+            except BaseException as e:  # noqa: BLE001
+                self._note(f"potrf fused trailing failed: {type(e).__name__}: {e}")
+        else:
+            self._note(f"potrf fused trailing skipped: {self.t_left():.0f}s left")
         # LAST (flips x64; nothing f32 runs after): the mixed-precision A/B —
         # f32-factor-plus-refinement posv vs emulated-f64 posv, the
         # on-hardware number behind the round-4 mixed-precision claim
@@ -488,6 +501,60 @@ class _Child:
         if "default" in rec and "bf16x3_refined" in rec:
             rec["speedup"] = round(
                 rec["default"]["seconds"] / rec["bf16x3_refined"]["seconds"], 2
+            )
+        return rec
+
+    def _time_potrf_fused_trailing(self, n):
+        """Fused trailing-update A/B at N=``n``, f32: lookahead POTRF with
+        ``trailing_update_impl='xla'`` vs ``'fused'`` on the full mesh,
+        with the two factors compared bit-for-bit (the fused consumer's
+        acceptance contract).  On the CPU mesh the fused leg goes through
+        the interpret-mode consume ring, so the seconds column measures
+        the interpreter, not VMEM residency — read it only for parity."""
+        import dlaf_tpu.testing as tu
+        from dlaf_tpu import tune
+        from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+        from dlaf_tpu.comm.grid import Grid
+        from dlaf_tpu.matrix.matrix import DistributedMatrix
+        from dlaf_tpu.miniapp.common import sync
+        from dlaf_tpu.plan import core as plan_core
+
+        # full mesh, NOT 1x1: the fused tier only engages on the SPMD
+        # lookahead kernel (a 1x1 grid takes the single-device fast path)
+        grid = Grid.create()
+        a = np.tril(tu.random_hermitian_pd(n, np.float32, seed=5))
+        flops = n**3 / 3
+        rec = {"metric": f"potrf_fused_trailing_n{n}_f32", "n": n, "nb": NB,
+               "grid": list(grid.grid_size)}
+        tp = tune.get_tune_parameters()
+        saved = (tp.trailing_update_impl, tp.cholesky_lookahead)
+        factors = {}
+        try:
+            tp.update(cholesky_lookahead=True)
+            for impl in ("xla", "fused"):
+                tp.update(trailing_update_impl=impl)
+                plan_core.reset()  # the knob is a trace-key suffix
+                best = None
+                for _ in range(2):  # warmup/compile, then timed
+                    mat = DistributedMatrix.from_global(grid, a, (NB, NB))
+                    sync(mat.data)
+                    t0 = time.perf_counter()
+                    out = cholesky_factorization("L", mat)
+                    sync(out.data)
+                    best = time.perf_counter() - t0
+                factors[impl] = np.asarray(out.to_global())
+                rec[impl] = {
+                    "seconds": round(best, 3),
+                    "gflops": round(flops / best / 1e9, 3),
+                }
+                if self.t_left() < 45:
+                    break
+        finally:
+            tp.update(trailing_update_impl=saved[0], cholesky_lookahead=saved[1])
+            plan_core.reset()
+        if "xla" in factors and "fused" in factors:
+            rec["bit_identical"] = bool(
+                np.array_equal(factors["xla"], factors["fused"])
             )
         return rec
 

@@ -74,6 +74,26 @@ def trsm_trace_key() -> bool:
     return bool(get_tune_parameters().panel_trsm_pallas)
 
 
+def trailing_update_trace_key() -> str:
+    """``tune.trailing_update_impl`` is consulted at TRACE time inside the
+    lookahead kernels (cholesky / triangular_solver route their bulk
+    trailing update through the fused Pallas consumer or the XLA einsum),
+    so every compiled kernel must carry the RESOLVED tier in its
+    compile-cache key — a knob outside the key is a dead knob.  'auto'
+    resolves here (plan.autotune.trailing_update_tier: profile override
+    or 'xla' — never 'fused' until the tpu_day stage-5h A/B lands,
+    matching the pallas-collectives precedent), so flipping a profile
+    retraces rather than aliasing executables."""
+    from dlaf_tpu.plan import autotune
+    from dlaf_tpu.tune import get_tune_parameters, validate_trailing_update_impl
+
+    impl = validate_trailing_update_impl(
+        get_tune_parameters().trailing_update_impl)
+    if impl == "auto":
+        return autotune.trailing_update_tier()
+    return impl
+
+
 def gemm_precision_trace_key() -> str:
     """``tune.gemm_precision`` is consulted at TRACE time inside
     ``ops.tile.contract`` (the split-GEMM tier of every trailing-update

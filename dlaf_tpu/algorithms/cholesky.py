@@ -84,7 +84,8 @@ def _warn_fused_decline(reason: str) -> None:
     )
 
 
-def _fused_panel_bcast(d, xc, below, root, overlap: bool):
+def _fused_panel_bcast(d, xc, below, root, overlap: bool,
+                       consumed: bool = False):
     """Fused factor-and-send for the lookahead panel: one Pallas kernel
     composing the potrf sweep, the column-blocked panel trsm, and the
     remote-DMA ring broadcast (ops/pallas_panel_exchange.fused_factor_bcast)
@@ -117,8 +118,49 @@ def _fused_panel_bcast(d, xc, below, root, overlap: bool):
     except NotImplementedError as e:
         _warn_fused_decline(repr(e))
         return None
-    _rec_comms("bcast_pallas", xc, COL_AXIS, overlapped=overlap)
+    # under the fused trailing-update tier the ring's hops are drained by
+    # the consume kernel — book the bytes as definitionally overlapped
+    _rec_comms("bcast_fused" if consumed else "bcast_pallas", xc, COL_AXIS,
+               overlapped=overlap)
     return lkk, cp
+
+
+def _fused_lookahead_step(x, cp, k, g: _spmd.Geometry, gi, gj):
+    """The whole lookahead body as ONE Pallas kernel
+    (``ops.pallas_trailing_update.fused_step``): consume-update of panel k
+    straight out of its ring landing slots, narrow update, diagonal
+    broadcast, factor, panel solve, and panel k+1's ring send — nothing
+    touches HBM between them.  TPU-only (remote DMA + Mosaic kernels);
+    returns None to take the two-piece fused path otherwise.  Same decline
+    discipline as :func:`_fused_panel_bcast`: only kernel-unavailable
+    declines fall back (with a one-time warning), anything else raises."""
+    if jax.default_backend() != "tpu" or not (
+        coll.axis_size(ROW_AXIS) > 1 or coll.axis_size(COL_AXIS) > 1
+    ):
+        return None
+    try:
+        from dlaf_tpu.ops import pallas_trailing_update as ptu
+    except ImportError as e:
+        _warn_fused_decline(repr(e))
+        return None
+    if not ptu.fused_step_supported(x, cp):
+        return None
+    taken, have = coll.transpose_panel_parts(cp, g.mt, g.ltc)
+    k1 = k + 1
+    params = jnp.stack([
+        k1 % g.pc, k1 % g.pr, k1 // g.pc, k1 // g.pr, k1 // g.pc,
+        0 * k, 0 * k, 0 * k,
+    ])
+    try:
+        out = ptu.fused_step(x, taken, have, gj == k1, cp, gi > k1, params)
+    except NotImplementedError as e:
+        _warn_fused_decline(repr(e))
+        return None
+    _rec_comms("transpose_panel_fused", taken, ROW_AXIS)
+    _rec_comms("bcast_fused", cp, COL_AXIS)        # panel k+1's ring send
+    _rec_comms("bcast_fused", x[0, 0], COL_AXIS)   # diag tile, 'c' ring
+    _rec_comms("bcast_fused", x[0, 0], ROW_AXIS)   # diag tile, 'r' ring
+    return out
 
 
 def _pivot_scan(d):
@@ -327,12 +369,25 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
     ``coll.overlap_window``: under the pallas collectives tier their DMA
     hops can drain beneath the bulk einsum and ``obs.comms`` books their
     modeled wire bytes as overlapped, and on TPU the panel factor+broadcast
-    collapses into the fused Pallas step (``_fused_panel_bcast``)."""
+    collapses into the fused Pallas step (``_fused_panel_bcast``).
+
+    Under ``tune.trailing_update_impl == 'fused'`` the bulk trailing update
+    routes through ``ops.pallas_trailing_update``: the row-panel exchange
+    and the update become one consumer (per-hop application out of the ring
+    landing slots on TPU; the one-shot in-kernel update on the interpret
+    parity path), issued BEFORE the narrow update and panel k+1.  The
+    reorder is bit-exact: the bulk excludes column k+1, whose slots enter
+    the update as exact zeros, and every operand panel k+1 reads (its
+    column, the diagonal tile, the broadcast selects) is either excluded
+    from the bulk or root-selected off ranks the bulk touched — so
+    ``(a - bulk) - narrow`` and ``(a - narrow) - bulk`` subtract the same
+    two addends per element in both orders."""
     x = coll.local(x)
     myr, myc = coll.my_rank()
     x = _spmd.pad_diag_identity(x, g, myr, myc)
     gi = _spmd.local_row_tiles(g, myr)
     gj = _spmd.local_col_tiles(g, myc)
+    fused_tier = _spmd.trailing_update_trace_key() == "fused"
 
     def compute_panel(x, k, overlap=False):
         # overlap=True: this is the lookahead panel — every collective in
@@ -344,7 +399,8 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
             d = _spmd.bcast_diag_tile(x, k, g, myr, myc)
             bad = _pivot_scan(d) if want_info else None
         xc = _spmd.take_col(x, k // g.pc, g)
-        fused = _fused_panel_bcast(d, xc, gi > k, k % g.pc, overlap)
+        fused = _fused_panel_bcast(d, xc, gi > k, k % g.pc, overlap,
+                                   consumed=fused_tier)
         if fused is not None:
             return fused[0], fused[1], bad
         with _scope("chol.diag_potrf"):
@@ -354,7 +410,8 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
             below = (gi > k)[:, None, None]
         with _scope("chol.panel_bcast"), win():
             cp = coll.bcast(
-                jnp.where(below, pan, jnp.zeros_like(pan)), k % g.pc, COL_AXIS
+                jnp.where(below, pan, jnp.zeros_like(pan)), k % g.pc, COL_AXIS,
+                consumed=fused_tier,
             )
         return lkk, cp, bad
 
@@ -375,23 +432,54 @@ def _chol_L_lookahead_kernel(x, g: _spmd.Geometry, want_info: bool = False):
         else:
             x, lkk, cp = carry
         x = write_back(x, k, lkk, cp)
-        with _scope("chol.panel_bcast"), coll.overlap_window():
-            rp = coll.transpose_panel(cp, g.mt, g.ltc)
-        # narrow update: column k+1 only, so its panel can start immediately
-        l_next = (k + 1) // g.pc
-        xc1 = _spmd.take_col(x, l_next, g)
-        rp1 = _spmd.take_tile(rp, l_next)
-        upd1 = t.contract("iab,cb->iac", cp, rp1.conj())
-        xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
-        x = _spmd.put_col(x, xc1, l_next)
-        # lookahead: panel k+1 from the already-updated column
-        lkk1, cp1, bad1 = compute_panel(x, k + 1, overlap=True)
+
+        def two_piece(x, k, cp):
+            if fused_tier:
+                # two-piece fused path: the exchange-and-consume kernel
+                # applies the bulk update (column k+1 excluded) BEFORE the
+                # narrow update and panel k+1 — bit-exact reorder, see the
+                # kernel docstring
+                from dlaf_tpu.ops import pallas_trailing_update as ptu
+
+                with _scope("chol.panel_bcast"), coll.overlap_window():
+                    taken, have = coll.transpose_panel_parts(
+                        cp, g.mt, g.ltc)
+                with _scope("chol.trailing_update"):
+                    x, rp = ptu.fused_transpose_update(
+                        x, cp, taken, have, gj == k + 1, ROW_AXIS)
+            else:
+                with _scope("chol.panel_bcast"), coll.overlap_window():
+                    rp = coll.transpose_panel(cp, g.mt, g.ltc)
+            # narrow update: column k+1 only, so its panel starts now
+            l_next = (k + 1) // g.pc
+            xc1 = _spmd.take_col(x, l_next, g)
+            rp1 = _spmd.take_tile(rp, l_next)
+            upd1 = t.contract("iab,cb->iac", cp, rp1.conj())
+            xc1 = jnp.where(myc == (k + 1) % g.pc, xc1 - upd1, xc1)
+            x = _spmd.put_col(x, xc1, l_next)
+            # lookahead: panel k+1 from the already-updated column
+            lkk1, cp1, bad1 = compute_panel(x, k + 1, overlap=True)
+            if not fused_tier:
+                # bulk trailing update, column k+1 excluded (already done)
+                with _scope("chol.trailing_update"):
+                    rp_bulk = jnp.where(
+                        (gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
+                    x = x - t.contract("iab,jcb->ijac", cp, rp_bulk.conj())
+            return x, lkk1, cp1, bad1
+
+        stepped = _fused_lookahead_step(x, cp, k, g, gi, gj) \
+            if fused_tier else None
+        if stepped is not None:
+            # single-kernel path (TPU): consume-update + narrow + factor +
+            # solve + send of panel k+1, one launch; the pivot scan reads
+            # the kernel's broadcast diagonal tile.  ``stepped`` is decided
+            # by trace-time static gates, identically on every rank.
+            x, _rp, lkk1, cp1, d1 = stepped
+            bad1 = _pivot_scan(d1) if want_info else None
+        else:
+            x, lkk1, cp1, bad1 = two_piece(x, k, cp)
         if want_info:
             info = jnp.where((info == 0) & (bad1 > 0), (k + 1) * g.mb + bad1, info)
-        # bulk trailing update, column k+1 excluded (already updated)
-        with _scope("chol.trailing_update"):
-            rp_bulk = jnp.where((gj == k + 1)[:, None, None], jnp.zeros_like(rp), rp)
-            x = x - t.contract("iab,jcb->ijac", cp, rp_bulk.conj())
         return (x, lkk1, cp1, info) if want_info else (x, lkk1, cp1)
 
     lkk0, cp0, bad0 = compute_panel(x, 0)

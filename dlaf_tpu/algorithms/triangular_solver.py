@@ -337,7 +337,19 @@ def _trsm_left_lookahead_kernel(a, b, g_a, g_b, uplo, op, diag, alpha):
         with _scope("trsm.update"):
             cp = panel(k)
             cp = jnp.where((gi == k1)[:, None, None], jnp.zeros_like(cp), cp)
-            b = b - t.contract("iab,jbc->ijac", cp, xr)
+            if _spmd.trailing_update_trace_key() == "fused":
+                # fused tier: the bulk update as ONE VMEM-resident Pallas
+                # kernel (in-kernel split-GEMM decomposition); compiled
+                # TPU keeps the XLA einsum for complex payloads (Mosaic
+                # has no complex arithmetic)
+                from dlaf_tpu.ops import pallas_trailing_update as ptu
+
+                if ptu.update_kernel_ok(b.dtype):
+                    b = ptu.trailing_update(b, cp, xr, "iab,jbc->ijac")
+                else:
+                    b = b - t.contract("iab,jbc->ijac", cp, xr)
+            else:
+                b = b - t.contract("iab,jbc->ijac", cp, xr)
         return b, xr1
 
     k0 = 0 if forward else mt - 1

@@ -18,10 +18,11 @@ Two checks:
 
 * **Mosaic collective-id discipline** — ``pallas_call`` sites must not
   pass a literal ``collective_id=<int>`` (two kernels sharing an id share
-  DMA semaphores: the shipped PR-6 bug), and ``dma_ring_exchange`` callers
-  must pass ``collective_id=...`` explicitly (the omitted default is the
-  shared id 0) — both must route through ``collective_id_for`` or the
-  module's reserved-id table.
+  DMA semaphores: the shipped PR-6 bug), and the DMA ring entry points
+  (``dma_ring_exchange``, and ``dma_ring_consume`` from the fused
+  trailing-update tier) must pass ``collective_id=...`` explicitly (the
+  omitted default is the shared id 0) — both must route through
+  ``collective_id_for`` or the module's reserved-id table.
 """
 from __future__ import annotations
 
@@ -44,6 +45,11 @@ COLLECTIVE_NAMES = frozenset({
     # pallas ring tier
     "ring_exchange", "ring_bcast", "dma_ring_exchange",
     "pallas_panel_exchange",
+    # fused trailing-update consumer (ops.pallas_trailing_update): the
+    # consume ring and the single-kernel lookahead step ring like any
+    # other exchange; fused_transpose_update wraps a ring either way
+    "dma_ring_consume", "fused_transpose_update", "fused_step",
+    "fused_factor_bcast",
 })
 
 #: Calls that yield a per-rank coordinate at trace time.
@@ -119,18 +125,23 @@ def check(project):
     return findings
 
 
-#: dma_ring_exchange(yf, h, ring_axis, mesh_axes, interpret, collective_id)
-_DMA_RING_CID_POS = 5
+#: collective_id's positional index in the DMA ring entry points whose
+#: signatures this rule knows:
+#:   dma_ring_exchange(yf, h, ring_axis, mesh_axes, interpret, collective_id)
+#:   dma_ring_consume(x, yf, h, cp, z, ring_axis, mesh_axes, interpret,
+#:                    collective_id, subscripts)
+_DMA_RING_CID_POS = {"dma_ring_exchange": 5, "dma_ring_consume": 8}
 
 
 def _check_collective_id(file, info, call):
     name = _last(dotted_name(call.func))
     out = []
-    # the collective_id value, whether passed by keyword or (for
-    # dma_ring_exchange, whose signature we know) positionally
+    # the collective_id value, whether passed by keyword or (for the DMA
+    # ring entry points, whose signatures we know) positionally
     cid_values = [kw.value for kw in call.keywords if kw.arg == "collective_id"]
-    if name == "dma_ring_exchange" and len(call.args) > _DMA_RING_CID_POS:
-        cid_values.append(call.args[_DMA_RING_CID_POS])
+    cid_pos = _DMA_RING_CID_POS.get(name)
+    if cid_pos is not None and len(call.args) > cid_pos:
+        cid_values.append(call.args[cid_pos])
     for value in cid_values:
         if isinstance(value, ast.Constant) and isinstance(value.value, int):
             out.append(Finding(
@@ -142,13 +153,13 @@ def _check_collective_id(file, info, call):
                     f"collective_id_for() or the reserved-id table"
                 ),
             ))
-    if name == "dma_ring_exchange" and not cid_values:
+    if cid_pos is not None and not cid_values:
         out.append(Finding(
             rule=RULE, path=file.rel, line=call.lineno, col=call.col_offset,
             symbol=info.qualname.split(":")[-1],
             message=(
-                "dma_ring_exchange without an explicit collective_id — the "
-                "default is the shared id 0; pass collective_id_for(kind, axis)"
+                f"{name} without an explicit collective_id — the "
+                f"default is the shared id 0; pass collective_id_for(kind, axis)"
             ),
         ))
     return out

@@ -187,7 +187,7 @@ def _forward_chain(y, have, axis: str):
 # ------------------------------------------------------------ primitives
 
 
-def bcast(x, root, axis: str):
+def bcast(x, root, axis: str, *, consumed: bool = False):
     """Broadcast ``x`` from the device with ``axis_index(axis) == root`` to
     all devices along ``axis``.  ``root`` may be traced.
 
@@ -196,7 +196,15 @@ def bcast(x, root, axis: str):
     doubling ppermute chain seeded at the (traced) root — a true one-
     contributor broadcast with no add-tree.  pallas tier: the neighbor-ring
     DMA kernel seeded the same way (ops/pallas_panel_exchange).  Size-1
-    axes are the identity."""
+    axes are the identity.
+
+    ``consumed=True`` marks the payload as consumed in-kernel by the fused
+    trailing-update tier (ops.pallas_trailing_update): under the pallas
+    tier the record kind becomes ``bcast_fused`` — its ring hops drain
+    under the update's MXU work, so ``obs.comms`` classifies the bytes as
+    overlapped unconditionally.  Only the pallas transport earns the tag
+    (the psum/v2 tiers lower to XLA collectives that barrier regardless);
+    the traced computation is identical either way."""
     if axis_size(axis) == 1:
         return x
     me = lax.axis_index(axis)
@@ -204,7 +212,7 @@ def bcast(x, root, axis: str):
     if impl == "pallas":
         from dlaf_tpu.ops import pallas_panel_exchange as ppe
 
-        _rec_tier("bcast_pallas", x, axis)
+        _rec_tier("bcast_fused" if consumed else "bcast_pallas", x, axis)
         return ppe.ring_bcast(x, me == root, axis)
     if impl == "v2":
         _rec("bcast_v2", x, axis)
@@ -291,6 +299,23 @@ def _panel_exchange(taken, have, axis: str):
     return lax.psum(contrib, axis)
 
 
+def transpose_panel_parts(cp, nr_row_tiles, ltc: int):
+    """The (taken, have) pair of :func:`transpose_panel` WITHOUT the
+    exchange: per output slot, this rank's candidate tile and whether this
+    rank is the slot's unique contributor along the row axis.  The fused
+    trailing-update consumer (ops.pallas_trailing_update) feeds these to
+    its own ring transport so the redistribution geometry — the diagonal-
+    crossing slot map of broadcast_panel.h — is stated exactly once."""
+    myr, myc = my_rank()
+    pr, pc = grid_shape()
+    ltr = cp.shape[0]
+    jv = jnp.arange(ltc) * pc + myc  # global tile index wanted at each slot
+    src_slot = jnp.clip(jv // pr, 0, ltr - 1)
+    have = (jv % pr == myr) & (jv < nr_row_tiles)
+    taken = jnp.take(cp, src_slot, axis=0)
+    return taken, have
+
+
 def transpose_panel(cp, nr_row_tiles, ltc: int):
     """Column panel -> row panel redistribution.
 
@@ -304,13 +329,7 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     Cost: one psum over the row axis of ``ltc`` tiles (psum tier), or a
     log2(Pr)-round ppermute chain with no reduction (v2 tier).
     """
-    myr, myc = my_rank()
-    pr, pc = grid_shape()
-    ltr = cp.shape[0]
-    jv = jnp.arange(ltc) * pc + myc  # global tile index wanted at each slot
-    src_slot = jnp.clip(jv // pr, 0, ltr - 1)
-    have = (jv % pr == myr) & (jv < nr_row_tiles)
-    taken = jnp.take(cp, src_slot, axis=0)
+    taken, have = transpose_panel_parts(cp, nr_row_tiles, ltc)
     return _panel_exchange(taken, have, ROW_AXIS)
 
 

@@ -50,6 +50,14 @@ the wire total and the overlapped column.  ``exposed = wire - overlapped``
 is the latency a panel step actually waits on; the psum/v2 tiers are hard
 XLA barriers, never overlapped, which is exactly the modeled difference
 the three-way A/B in ``scripts/collectives_ab.py`` reports.
+
+Kinds ending ``_fused`` (the trailing-update consumer of
+``ops.pallas_trailing_update``, which reads panel operands straight out of
+the ring-DMA landing slots) are *definitionally* overlapped: every hop's
+bytes are consumed by the in-kernel update while the next hop's DMA is in
+flight, window or no window, so :func:`record` forces their overlap flag.
+Their wire cost is the same one-contributor ``(P-1)/P`` ring as the
+v2/pallas tiers.
 """
 from __future__ import annotations
 
@@ -100,7 +108,8 @@ def wire_model(kind: str, axis_size: int, nbytes: int) -> int:
     p = int(axis_size)
     if p <= 1:
         return 0
-    if kind.endswith("_v2") or kind.endswith("_pallas"):
+    if kind.endswith("_v2") or kind.endswith("_pallas") \
+            or kind.endswith("_fused"):
         return round((p - 1) * nbytes / p)
     if kind == "shift":
         return nbytes
@@ -115,10 +124,13 @@ def record(kind: str, x, axis: str | None = None, overlapped: bool = False) -> N
     handed to the ``lax`` collective, ``axis`` its mesh axis (None for 2D /
     axis-free ops).  ``overlapped=True`` classifies the modeled wire bytes
     as drainable under trailing compute (pallas DMA tier inside a
-    ``collectives.overlap_window``).  Runs at trace time only; no-op unless
+    ``collectives.overlap_window``); kinds ending ``_fused`` are forced
+    overlapped — the trailing-update consumer drains hops under its own
+    MXU work by construction.  Runs at trace time only; no-op unless
     :func:`start`."""
     if _acc is None:
         return
+    overlapped = overlapped or kind.endswith("_fused")
     try:
         size = lax.psum(1, axis) if axis is not None else 0
     except (NameError, KeyError, ValueError):  # outside an axis context

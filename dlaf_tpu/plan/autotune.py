@@ -149,6 +149,22 @@ def collectives_tier(backend: str | None = None) -> str:
     return "v2" if backend != "cpu" else "psum"
 
 
+def trailing_update_tier() -> str:
+    """Resolution of ``tune.trailing_update_impl == 'auto'``: profile
+    override when present (a measured tpu_day stage-5h sweep may promote
+    the fused Pallas consumer — the explicit measurement the tier is
+    gated on), else 'xla' on every backend: the fused tier's win is a
+    VMEM-residency/overlap claim only hardware can substantiate, exactly
+    the pallas-collectives precedent."""
+    o = _auto_override("trailing_update_impl")
+    if o is not None:
+        from dlaf_tpu.tune import validate_trailing_update_impl
+
+        validate_trailing_update_impl(o)
+        return o
+    return "xla"
+
+
 def shard_batch(op: str, n: int, dtype="float32") -> bool:
     """Serve mesh mode for order ``n``: batch-sharded below
     ``tune.serve_batch_shard_max_n`` (one element per device, collectives

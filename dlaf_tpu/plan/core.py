@@ -5,8 +5,9 @@ hand-folded the trace-time knobs into its key — ~10 independent cache
 sites that only the DLAF001 linter kept honest.  Here the key is built in
 one place: ``plan_key(op, static_key)`` appends :func:`trace_suffix` — the
 full trace-key set (collectives tier, panel-TRSM pallas flag, split-GEMM
-tier, bucket ratio, lookahead knobs, the serve bucket token, and the
-autotune profile fingerprint) — to the caller's static geometry key.
+tier, trailing-update tier, bucket ratio, lookahead knobs, the serve
+bucket token, and the autotune profile fingerprint) — to the caller's
+static geometry key.
 Call sites keep only what is genuinely per-site (grid identity, Geometry,
 uplo, variant, dtype); everything ambient comes from the suffix, uniformly.
 Uniform over-keying is deliberate: a masked-variant kernel retracing when
@@ -121,6 +122,7 @@ def trace_suffix() -> tuple:
         coll.collectives_trace_key(),
         _spmd.trsm_trace_key(),
         _spmd.gemm_precision_trace_key(),
+        _spmd.trailing_update_trace_key(),
         _spmd.bucket_ratio(),
         bool(p.trsm_lookahead),
         bool(p.cholesky_lookahead),

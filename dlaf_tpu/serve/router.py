@@ -41,7 +41,8 @@ class Replica:
     planned-maintenance drains)."""
 
     def __init__(self, name: str, pool, *, watchdog=None,
-                 probe_budget_s: float = 5.0):
+                 probe_budget_s: float = 5.0, warm: bool = False,
+                 warmup_kwargs: dict | None = None):
         self.name = str(name)
         self.pool = pool
         self.watchdog = (
@@ -50,6 +51,33 @@ class Replica:
             else resilience.DeviceWatchdog(budget_s=float(probe_budget_s))
         )
         self.healthy = True
+        self.warm_summary: dict | None = None
+        if warm:
+            self.warmup(**(warmup_kwargs or {}))
+
+    def warmup(self, **kwargs) -> dict:
+        """Prefetch this replica's executables through ``plan.warmup`` on
+        the pool's own grid and bucket cache, so the first request a
+        fresh mesh serves hits a populated plan (and, with the persistent
+        compilation cache configured, AOT-loads instead of compiling).
+        Every plan the fused trailing-update tier registers flows through
+        the same path — its executables warm like any other.  Keyword
+        arguments pass straight to ``plan.warmup`` (buckets, ops, dtypes,
+        nrhs).  Stores and returns the warmup summary, and emits a
+        ``serve`` ``replica_warmup`` event with the compile attribution."""
+        from dlaf_tpu.plan import core as plan_core
+
+        kwargs.setdefault("grid", self.pool.grid)
+        kwargs.setdefault("cache", self.pool.cache)
+        self.warm_summary = plan_core.warmup(**kwargs)
+        om.emit(
+            "serve", event="replica_warmup", replica=self.name,
+            plans=self.warm_summary["plans"],
+            compiles=self.warm_summary["compiles"],
+            aot_loads=self.warm_summary["aot_loads"],
+            seconds=self.warm_summary["seconds"],
+        )
+        return self.warm_summary
 
     def pending(self) -> int:
         return self.pool.pending()

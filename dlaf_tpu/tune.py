@@ -157,6 +157,25 @@ class TuneParameters:
       (collectives.collectives_trace_key), so flipping it between calls
       retraces correctly.  True multi-contributor sums (psum_axis) are
       reductions in every tier.
+    - ``trailing_update_impl``: implementation tier for the lookahead
+      trailing update (the bulk ``x - cp @ rp^H`` einsum behind every
+      panel step).  'xla' = the einsum as XLA HLO (panels round-trip
+      through HBM between the exchange and the GEMM); 'fused' = the
+      Pallas trailing-update consumer (ops/pallas_trailing_update): the
+      GEMM/HERK reads panel operands straight out of the ring-DMA
+      landing slots of the panel exchange (per-slot recv semaphores gate
+      each hop's update slice) with the bf16x3/bf16x6 split-GEMM slice
+      decomposition traced INSIDE the kernel, so the MXU consumes bf16
+      operands without the slices round-tripping through HBM; on CPU
+      backends the tier runs a ppermute-transport ring plus the update
+      kernel in Pallas interpret mode — bit-identical to 'xla' (the
+      tier-1 acceptance path); 'auto' (default) = 'xla' until the
+      scripts/tpu_day.sh stage-5h A/B promotes the fused tier (never
+      'fused' unmeasured, matching the pallas-collectives precedent; a
+      plan profile may override).  Values outside {xla, fused, auto}
+      raise health.ConfigurationError.  Read at trace time; the resolved
+      tier is part of plan.trace_suffix (_spmd.trailing_update_trace_key)
+      so every compiled-kernel cache retraces on a flip.
     - ``serve_buckets``: comma-separated problem orders the serve layer
       pads requests up to (``dlaf_tpu.serve``); a request of order n runs
       at the smallest bucket >= n, sizes beyond the largest round up to a
@@ -232,6 +251,9 @@ class TuneParameters:
     # CPU-validated (interpret-mode parity tests), DEFAULT OFF until an
     # on-hardware A/B justifies them — nothing lands unmeasured.
     collectives_impl: str = field(default_factory=lambda: _env("collectives_impl", "auto", str))
+    trailing_update_impl: str = field(
+        default_factory=lambda: _env("trailing_update_impl", "auto", str)
+    )
     serve_buckets: str = field(
         default_factory=lambda: _env("serve_buckets", "256,512,1024,2048", str)
     )
@@ -265,6 +287,8 @@ class TuneParameters:
                 raise ValueError(f"unknown tune parameter {k!r}")
             if k == "collectives_impl":
                 validate_collectives_impl(v)
+            elif k == "trailing_update_impl":
+                validate_trailing_update_impl(v)
             elif k == "gemm_precision":
                 validate_gemm_precision(v)
             elif k in ("blas3_matmul_precision", "eigensolver_matmul_precision"):
@@ -274,7 +298,25 @@ class TuneParameters:
 
 
 COLLECTIVES_IMPLS = ("psum", "v2", "pallas", "auto")
+TRAILING_UPDATE_IMPLS = ("xla", "fused", "auto")
 GEMM_PRECISIONS = ("default", "bf16x3", "bf16x6", "auto")
+
+
+def validate_trailing_update_impl(value) -> str:
+    """Reject trailing-update tiers outside the documented domain — same
+    fail-fast shape as :func:`validate_collectives_impl`: checked on
+    explicit ``update(trailing_update_impl=...)`` AND when the lookahead
+    kernels resolve the knob at trace time, so a typo'd
+    ``DLAF_TPU_TRAILING_UPDATE_IMPL`` env value surfaces as a
+    ConfigurationError, not a deep-trace failure."""
+    if value not in TRAILING_UPDATE_IMPLS:
+        from dlaf_tpu.health import ConfigurationError
+
+        raise ConfigurationError(
+            f"trailing_update_impl must be one of {TRAILING_UPDATE_IMPLS}, "
+            f"got {value!r} (env DLAF_TPU_TRAILING_UPDATE_IMPL)"
+        )
+    return value
 
 
 def validate_gemm_precision(value) -> str:

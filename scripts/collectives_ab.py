@@ -1,9 +1,16 @@
 #!/usr/bin/env python
-"""Three-way collectives-tier A/B: psum vs v2 vs pallas POTRF throughput.
+"""Collectives-tier A/B: psum vs v2 vs pallas (vs fused) POTRF throughput.
 
 Usage: python scripts/collectives_ab.py [--m 4096] [--mb 512] [--nruns 2]
-           [--grid RxC] [--tiers psum,v2,pallas] [--probe-budget 20]
+           [--grid RxC] [--tiers psum,v2,pallas,fused] [--probe-budget 20]
            [--out ab.json] [--metrics ab.jsonl]
+
+The ``fused`` leg is the pallas collectives tier PLUS
+``trailing_update_impl='fused'`` (ops/pallas_trailing_update): the
+trailing GEMM consumes the exchanged row panel straight out of the
+ring-DMA landing slots.  Its row A/Bs against the plain ``pallas`` leg —
+the measurement that gates promoting ``trailing_update_impl='auto'`` to
+the fused tier (tpu_day stage 5h).
 
 For each tier: one ``DeviceWatchdog`` probe (the bench.py liveness
 protocol — a dead TPU window classifies as ``DeviceUnresponsiveError``
@@ -31,6 +38,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TIERS = ("psum", "v2", "pallas")
+#: pseudo-tier: pallas collectives + the fused Pallas trailing-update
+#: consumer (``tune.trailing_update_impl='fused'``)
+FUSED_TIER = "fused"
 
 
 def _bench_tier(tier, grid, args, om, ocomms):
@@ -53,7 +63,12 @@ def _bench_tier(tier, grid, args, om, ocomms):
         return row
     row["alive"] = True
 
-    tune.get_tune_parameters().update(collectives_impl=tier)
+    if tier == FUSED_TIER:
+        tune.get_tune_parameters().update(
+            collectives_impl="pallas", trailing_update_impl="fused")
+    else:
+        tune.get_tune_parameters().update(
+            collectives_impl=tier, trailing_update_impl="xla")
     a = np.tril(tu.random_hermitian_pd(args.m, np.float32, seed=11))
     ocomms.start()
     times = []
@@ -103,12 +118,20 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-budget", type=float, default=20.0)
     ap.add_argument("--out", default="")
     ap.add_argument("--metrics", default="")
+    ap.add_argument("--flight-dir", default="",
+                    help="enable the crash flight recorder; a failed "
+                         "watchdog probe drops flight_*.json here")
     args = ap.parse_args(argv)
 
     from dlaf_tpu import tune
     from dlaf_tpu.comm.grid import Grid, Size2D
     from dlaf_tpu.obs import comms as ocomms
     from dlaf_tpu.obs import metrics as om_mod
+
+    if args.flight_dir:
+        from dlaf_tpu.obs import flight
+
+        flight.enable(dump_dir=args.flight_dir)
 
     om = None
     if args.metrics:
@@ -126,7 +149,8 @@ def main(argv=None) -> int:
     # lookahead is the consumer the pallas tier exists for — pin it on, and
     # restore the caller's knobs afterwards
     tp = tune.get_tune_parameters()
-    saved = (tp.collectives_impl, tp.cholesky_lookahead)
+    saved = (tp.collectives_impl, tp.cholesky_lookahead,
+             tp.trailing_update_impl)
     tp.update(cholesky_lookahead=True)
     try:
         results = [
@@ -134,7 +158,8 @@ def main(argv=None) -> int:
             for t in args.tiers.split(",") if t.strip()
         ]
     finally:
-        tp.update(collectives_impl=saved[0], cholesky_lookahead=saved[1])
+        tp.update(collectives_impl=saved[0], cholesky_lookahead=saved[1],
+                  trailing_update_impl=saved[2])
         if om is not None:
             om_mod.close()
     if args.out:

@@ -588,6 +588,22 @@ def test_reverted_bug_dlaf002_dropped_collective_id():
     assert "without an explicit collective_id" in findings[0].message
 
 
+def test_reverted_bug_dlaf002_consume_ring_dropped_collective_id():
+    """The same bug class on the fused trailing-update consumer: dropping
+    the explicit id from the dma_ring_consume call site would silently
+    share id 0 with every other ring the scheduler can overlap with."""
+    proj = _real_tree_project(
+        "dlaf_tpu/ops/pallas_trailing_update.py",
+        lambda text: text.replace(
+            '\n            ppe.collective_id_for("consume", ring_axis),', ""),
+    )
+    findings = [f for f in collectives.check(proj)
+                if f.path == "dlaf_tpu/ops/pallas_trailing_update.py"]
+    assert len(findings) == 1
+    assert "dma_ring_consume without an explicit collective_id" \
+        in findings[0].message
+
+
 def test_reverted_bug_dlaf003_host_sync_in_dma_ring():
     """A .item() debug probe inside the jitted DMA ring entry point is the
     classic silent per-call device sync."""
