@@ -11,6 +11,14 @@ futures.  Completing futures (``set_result``/``set_exception``) under a
 held lock is the subtler variant: done-callbacks run synchronously on the
 completing thread and re-enter whatever lock they like.
 
+Since serve v3 the same discipline covers the WIRE: socket I/O
+(``sendall``/``recv``/``accept``/``connect``) and whole-frame transfers
+(``send_frame``/``recv_frame``) block on a remote peer — holding a lock
+across them couples every local thread to the network.  The one
+sanctioned pattern is a *dedicated per-socket send lock* (serializing
+writers on one fd is the lock's entire job); those sites carry explicit
+``dlaf: ignore[DLAF004]`` suppressions with the justification inline.
+
 Scope: files under ``serve/`` plus ``resilience.py`` (the rule is a
 *policy* for that layer, not a general theorem — kernel modules use no
 locks).  Lock-held regions are (a) ``with <lock-like>:`` bodies, where
@@ -40,6 +48,9 @@ BLOCKING_ATTRS = frozenset({
     "adopt", "drain",            # pool dispatch surface
     "submit", "submit_nowait",   # pool/gateway admission (takes their locks)
     "acquire",                   # nested lock acquisition
+    # wire/IPC surface (serve v3): each blocks on a remote peer
+    "sendall", "recv", "accept", "connect",
+    "send_frame", "recv_frame",
 })
 COMPLETION_ATTRS = frozenset({"set_result", "set_exception"})
 

@@ -153,6 +153,35 @@ class TenantQuotaExceededError(QueueFullError):
         )
 
 
+class WireProtocolError(DlafError, RuntimeError):
+    """A serve fleet wire frame violated the framing contract
+    (``serve.wire``): bad magic, a length prefix beyond the frame bound,
+    a stream that ended mid-frame, or a header that is not valid JSON.
+    ``reason`` is a short machine-stable tag (``"magic"`` / ``"oversize"``
+    / ``"truncated"`` / ``"header"`` / ``"array"``) so tests and the
+    supervisor's restart policy can branch without string-matching the
+    human message."""
+
+    def __init__(self, reason: str, message: str | None = None):
+        self.reason = str(reason)
+        super().__init__(
+            message or f"wire protocol violation ({self.reason})"
+        )
+
+
+class RemoteWorkerError(DlafError, RuntimeError):
+    """A fleet worker process reported a failure whose type has no
+    constructor mapping in the wire error registry (``serve.wire``
+    rebuilds known taxonomy errors typed; everything else lands here).
+    ``remote_type`` preserves the original exception class name."""
+
+    def __init__(self, remote_type: str, message: str | None = None):
+        self.remote_type = str(remote_type)
+        super().__init__(
+            message or f"worker raised {self.remote_type}"
+        )
+
+
 class DeviceUnresponsiveError(DlafError, RuntimeError):
     """The device watchdog's bounded liveness probe was exhausted: the
     device did not answer a tiny pre-compiled kernel within ``budget_s``

@@ -38,7 +38,7 @@ from dlaf_tpu.obs import metrics as om
 SCHEMA = "dlaf_tpu.flight/1"
 
 #: record kinds mirrored from the metrics stream into the ring.
-_TEE_KINDS = frozenset({"span", "serve", "health", "note"})
+_TEE_KINDS = frozenset({"span", "serve", "health", "note", "fleet"})
 
 _lock = threading.Lock()
 _ring: collections.deque | None = None
@@ -204,6 +204,39 @@ def auto_dump(reason: str) -> str | None:
         return dump(reason)
     except Exception:
         return None
+
+
+def collect(src_dir: str, dst_dir: str, tag: str) -> list:
+    """Gather another process's flight dumps: copy every ``flight_*.json``
+    in ``src_dir`` into ``dst_dir`` with ``tag`` spliced into the name
+    (``flight_<tag>_<rest>``), skipping files already collected.  Used by
+    the serve fleet supervisor to pull a dead worker's dumps into the
+    parent flight dir stamped with the worker id.  Never raises — like
+    :func:`auto_dump`, evidence collection must not mask the failure being
+    collected; returns the list of destination paths written."""
+    out: list = []
+    try:
+        names = sorted(f for f in os.listdir(src_dir)
+                       if f.startswith("flight_") and f.endswith(".json"))
+    except OSError:
+        return out
+    safe_tag = "".join(c if c.isalnum() or c in "-_" else "-" for c in tag)
+    for name in names:
+        dst = os.path.join(dst_dir, f"flight_{safe_tag}_{name[len('flight_'):]}")
+        if os.path.exists(dst):
+            continue
+        try:
+            os.makedirs(dst_dir, exist_ok=True)
+            with open(os.path.join(src_dir, name), "rb") as src_fh:
+                data = src_fh.read()
+            tmp = f"{dst}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as dst_fh:
+                dst_fh.write(data)
+            os.replace(tmp, dst)
+            out.append(dst)
+        except OSError:
+            continue
+    return out
 
 
 # ------------------------------------------------- memory watermark sampler

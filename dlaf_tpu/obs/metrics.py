@@ -27,9 +27,13 @@ dump pointers, ``obs.flight``) kinds; ``/3`` adds the ``scenario``
 ``scenario.capacity``) kinds, and stamps ``gw.request`` root spans with
 the replayable request attrs (shape, dtype, deadline, batch group key);
 ``/4`` adds the ``plan`` kind (unified executable-plan cache events —
-hit/miss/build/evict/warmup/decision, ``dlaf_tpu.plan``).
-Writers stamp ``/4``; readers (:func:`validate_record`,
-:func:`read_jsonl`) accept all four so old BENCH and metrics artifacts
+hit/miss/build/evict/warmup/decision, ``dlaf_tpu.plan``); ``/5`` adds
+the ``fleet`` kind (cross-process serve fleet lifecycle — worker spawn/
+ready/exit/restart, circuit breaker, failover re-dispatch, autoscale
+decisions with their triggering signals, child flight-dump collection;
+``dlaf_tpu.serve.supervisor`` / ``serve.fleet``).
+Writers stamp ``/5``; readers (:func:`validate_record`,
+:func:`read_jsonl`) accept all five so old BENCH and metrics artifacts
 keep parsing.
 """
 from __future__ import annotations
@@ -40,10 +44,10 @@ import sys
 import threading
 import time
 
-SCHEMA = "dlaf_tpu.obs/4"
-#: every schema tag a reader accepts (old artifacts carry /1 - /3).
+SCHEMA = "dlaf_tpu.obs/5"
+#: every schema tag a reader accepts (old artifacts carry /1 - /4).
 SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2", "dlaf_tpu.obs/3",
-           "dlaf_tpu.obs/4")
+           "dlaf_tpu.obs/4", "dlaf_tpu.obs/5")
 
 #: kind -> payload fields every record of that kind must carry.
 REQUIRED_FIELDS: dict = {
@@ -67,6 +71,8 @@ REQUIRED_FIELDS: dict = {
     "capacity": ("event",),
     # /4 additions:
     "plan": ("event",),
+    # /5 additions:
+    "fleet": ("event",),
 }
 
 _emitter = None

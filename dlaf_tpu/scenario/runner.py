@@ -15,6 +15,16 @@ continuous batching → ``Router`` placement):
   injections at scheduled offsets on a worker thread, and the scenario's
   own :class:`~dlaf_tpu.scenario.spec.SLO` decides pass/fail.
 
+``run_scenario(fleet=True)`` swaps the in-process replica pools for a
+:class:`~dlaf_tpu.serve.fleet.Fleet` of real worker OS processes behind
+the same ``Gateway`` front door: faults escalate from probe patches to
+process-level injections (``replica_down`` becomes a real SIGKILL via
+``testing.faults.process_kill``; ``network_partition`` blocks the wire),
+a background pump drives :meth:`~dlaf_tpu.serve.fleet.Fleet.tick`
+throughout the run, and with ``autoscale=True`` the run additionally
+gates on the autoscaler's behaviour (scaled up under load, scaled back
+down, bounded oscillation).
+
 Both stamp ``run_meta`` with the scenario name, seed, and gateway sizing
 so every JSONL artifact is self-identifying (and replayable —
 ``scenario.replay`` reads the sizing back out of ``run_meta``).
@@ -233,13 +243,47 @@ def _chaos_steps(gw, router, fault: sspec.FaultEvent, time_scale: float):
     gw.check_replicas()
 
 
+def _chaos_steps_fleet(fleet, fault: sspec.FaultEvent, time_scale: float):
+    """Fleet-mode fault window (blocking; called via
+    ``asyncio.to_thread``).  Faults are process-level here:
+    ``replica_down`` escalates to a real SIGKILL (an in-process probe
+    patch cannot cross a process boundary, and the spec's intent — that
+    replica stops serving — maps exactly onto killing it);
+    ``process_kill`` is that SIGKILL by name; ``network_partition`` holds
+    the parent→worker wire down for the fault window.  The window keeps
+    pumping :meth:`~dlaf_tpu.serve.fleet.Fleet.tick` so drains, restarts
+    and adoptions progress while the fault holds."""
+    from dlaf_tpu.testing import faults as tfaults
+
+    hold_s = fault.seconds * time_scale
+
+    def sweep_until(deadline):
+        fleet.tick()
+        while True:
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                return
+            time.sleep(min(0.25, rem))
+            fleet.tick()
+
+    if fault.kind in ("replica_down", "process_kill"):
+        tfaults.process_kill(fleet, fault.target)
+        sweep_until(time.monotonic() + hold_s)
+    else:  # network_partition
+        with tfaults.network_partition(fleet, fault.target, seconds=None):
+            sweep_until(time.monotonic() + hold_s)
+    fleet.tick()
+
+
 async def _drive_open_loop(gw, router, schedule, bank, scenario,
-                           time_scale: float) -> dict:
+                           time_scale: float, fleet=None) -> dict:
     """Open-loop: submit each request at its arrival offset, run the
     fault timeline alongside, classify every completion.  A warmup pass
     (one request per distinct (kind, n) in the schedule, under
     :data:`WARMUP_TENANT`) compiles every group key before the clock
-    starts."""
+    starts.  In fleet mode a background pump drives ``fleet.tick()``
+    (probe sweep + autoscaler step) for the whole run, not just inside
+    fault windows — elasticity decisions must see quiet traffic too."""
     counts = new_counts()
 
     async def warm_one(kind, n):
@@ -268,11 +312,32 @@ async def _drive_open_loop(gw, router, schedule, bank, scenario,
         delay = t0 + fault.at_s * time_scale - time.monotonic()
         if delay > 0:
             await asyncio.sleep(delay)
-        await asyncio.to_thread(_chaos_steps, gw, router, fault, time_scale)
+        if fleet is not None:
+            await asyncio.to_thread(_chaos_steps_fleet, fleet, fault,
+                                    time_scale)
+        else:
+            await asyncio.to_thread(_chaos_steps, gw, router, fault,
+                                    time_scale)
 
+    stop = asyncio.Event()
+
+    async def pump():
+        while not stop.is_set():
+            await asyncio.to_thread(fleet.tick)
+            try:
+                await asyncio.wait_for(stop.wait(), 0.5)
+            except (asyncio.TimeoutError, TimeoutError):
+                pass
+
+    pump_task = asyncio.create_task(pump()) if fleet is not None else None
     tasks = [one(arr) for arr in schedule]
     tasks.extend(chaos(f) for f in scenario.faults)
-    await asyncio.gather(*tasks)
+    try:
+        await asyncio.gather(*tasks)
+    finally:
+        stop.set()
+        if pump_task is not None:
+            await pump_task
     return counts
 
 
@@ -337,26 +402,78 @@ def evaluate_slos(scenario: sspec.Scenario, counts: dict, stats: dict,
     return fails
 
 
+def evaluate_autoscale(actions: list, max_actions: int = 6) -> list:
+    """Gate an autoscaled run on the elasticity contract: the fleet must
+    have scaled UP under load, scaled back DOWN after it, and not flapped
+    (a bounded number of decisions for one diurnal-ish load shape —
+    hysteresis is the thing under test).  Returns failure strings."""
+    fails = []
+    ups = sum(1 for a in actions if a["action"] == "scale_up")
+    downs = sum(1 for a in actions if a["action"] == "scale_down")
+    if not ups:
+        fails.append("autoscale: never scaled up under load")
+    if not downs:
+        fails.append("autoscale: never scaled back down after load")
+    if len(actions) > max_actions:
+        fails.append(f"autoscale: {len(actions)} scale decisions (> "
+                     f"{max_actions}) — hysteresis failed to damp flapping")
+    return fails
+
+
+#: fault kinds that only make sense against real worker processes.
+_FLEET_ONLY_FAULTS = ("process_kill", "network_partition")
+
+
 def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
                  out: str | None = None, trace_out: str | None = None,
-                 time_scale: float = 1.0, quiet: bool = False) -> ScenarioResult:
+                 time_scale: float = 1.0, quiet: bool = False,
+                 fleet: bool = False, workers: int | None = None,
+                 autoscale: bool = False, min_workers: int = 1,
+                 max_workers: int = 4) -> ScenarioResult:
     """Execute one scenario end-to-end and evaluate its SLOs.
 
     ``requests`` overrides the spec's count (the CI lane runs 500);
     ``time_scale`` compresses/stretches the arrival + fault timeline
     (tests use < 1).  When ``out`` is set the run's JSONL lands there
     (including a ``scenario`` result record); ``trace_out`` additionally
-    enables span tracing and writes the Chrome-trace export."""
-    if trace_out and not out:
-        from dlaf_tpu.health import ConfigurationError
+    enables span tracing and writes the Chrome-trace export.
 
+    ``fleet=True`` serves through a
+    :class:`~dlaf_tpu.serve.fleet.Fleet` of ``workers`` (default: the
+    spec's replica count) real worker processes: ``replica_down`` faults
+    escalate to real SIGKILLs, ``process_kill`` / ``network_partition``
+    faults become available, and ``hang`` is rejected (an in-process
+    injection cannot cross a process boundary — partition the wire
+    instead).  ``autoscale=True`` (fleet only) turns on the elastic
+    autoscaler between ``min_workers`` and ``max_workers`` and gates the
+    run on its behaviour (see :func:`evaluate_autoscale`)."""
+    from dlaf_tpu.health import ConfigurationError
+
+    if trace_out and not out:
         raise ConfigurationError(
             "run_scenario: trace_out requires out (spans ride the JSONL "
             "stream the export reads)")
+    if fleet:
+        if any(f.kind == "hang" for f in scenario.faults):
+            raise ConfigurationError(
+                "run_scenario: 'hang' faults cannot cross a process "
+                "boundary in fleet mode — use 'network_partition'")
+    else:
+        if autoscale:
+            raise ConfigurationError(
+                "run_scenario: autoscale requires fleet=True (only the "
+                "fleet has worker processes to scale)")
+        bad = sorted({f.kind for f in scenario.faults
+                      if f.kind in _FLEET_ONLY_FAULTS})
+        if bad:
+            raise ConfigurationError(
+                f"run_scenario: fault kinds {bad} target real worker "
+                f"processes — run with fleet=True")
     n = int(requests if requests is not None else scenario.requests)
     schedule = build_schedule(scenario, n)
     shapes = sorted({arr.n for arr in schedule})
     bank = problem_bank(shapes=shapes, nrhs=scenario.mix.nrhs)
+    n_workers = int(workers if workers is not None else scenario.replicas)
 
     if out:
         om.enable(out)
@@ -366,32 +483,72 @@ def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
         "scenario", scenario=scenario.name, seed=scenario.seed,
         requests=n, replicas=scenario.replicas,
         buckets=scenario.buckets, max_batch=scenario.max_batch,
-        linger_ms=scenario.linger_ms,
+        linger_ms=scenario.linger_ms, fleet=bool(fleet),
+        workers=n_workers if fleet else scenario.replicas,
+        autoscale=bool(autoscale),
     )
     tune.initialize(serve_buckets=scenario.buckets)
-    pools = [serve.SolverPool(block_size=8, max_batch=scenario.max_batch)
-             for _ in range(scenario.replicas)]
-    router = serve.Router([
-        serve.Replica(f"replica{i}", p, probe_budget_s=scenario.probe_budget_s)
-        for i, p in enumerate(pools)
-    ])
+    tenants = scenario.tenant_configs()
+    tenants.append(serve.TenantConfig(WARMUP_TENANT))
+    autoscale_fails: list = []
     t0 = time.monotonic()
-    try:
-        tenants = scenario.tenant_configs()
-        tenants.append(serve.TenantConfig(WARMUP_TENANT))
-        gw = serve.Gateway(router, tenants,
-                           max_batch=scenario.max_batch,
-                           linger_ms=scenario.linger_ms)
-        counts = asyncio.run(
-            _drive_open_loop(gw, router, schedule, bank, scenario, time_scale))
-        gw.close()
-        stats = gw.stats()
-    finally:
-        router.close()
-        tune.initialize()
+    if fleet:
+        fl = serve.Fleet(
+            tenants, workers=n_workers, buckets=scenario.buckets,
+            block_size=8, max_batch=scenario.max_batch,
+            linger_ms=scenario.linger_ms, nrhs=scenario.mix.nrhs,
+            probe_budget_s=scenario.probe_budget_s, autoscale=autoscale,
+            min_workers=int(min_workers), max_workers=int(max_workers),
+        )
+        try:
+            counts = asyncio.run(
+                _drive_open_loop(fl.gateway, fl.router, schedule, bank,
+                                 scenario, time_scale, fleet=fl))
+            if autoscale and any(a["action"] == "scale_up"
+                                 for a in fl.autoscaler.actions):
+                # cool-down epilogue: the elasticity contract includes
+                # scaling BACK DOWN once the load passes, which can only
+                # be observed past the last arrival (the queue drains at
+                # the end of an overloaded run) — keep pumping until the
+                # scale-down lands or its cooldown window conclusively
+                # passes without one
+                deadline = (time.monotonic()
+                            + fl.autoscaler.down_cooldown_s + 10.0)
+                while (time.monotonic() < deadline
+                       and not any(a["action"] == "scale_down"
+                                   for a in fl.autoscaler.actions)):
+                    fl.tick()
+                    time.sleep(0.25)
+            fl.close()
+            stats = fl.stats()
+            if autoscale:
+                autoscale_fails = evaluate_autoscale(fl.autoscaler.actions)
+        finally:
+            fl.close()
+            tune.initialize()
+    else:
+        pools = [serve.SolverPool(block_size=8, max_batch=scenario.max_batch)
+                 for _ in range(scenario.replicas)]
+        router = serve.Router([
+            serve.Replica(f"replica{i}", p,
+                          probe_budget_s=scenario.probe_budget_s)
+            for i, p in enumerate(pools)
+        ])
+        try:
+            gw = serve.Gateway(router, tenants,
+                               max_batch=scenario.max_batch,
+                               linger_ms=scenario.linger_ms)
+            counts = asyncio.run(
+                _drive_open_loop(gw, router, schedule, bank, scenario,
+                                 time_scale))
+            gw.close()
+            stats = gw.stats()
+        finally:
+            router.close()
+            tune.initialize()
     elapsed = time.monotonic() - t0
 
-    failures = evaluate_slos(scenario, counts, stats, n)
+    failures = evaluate_slos(scenario, counts, stats, n) + autoscale_fails
     om.emit("scenario", event="result", scenario=scenario.name,
             seed=scenario.seed, requests=n, elapsed_s=elapsed,
             passed=not failures, failures=failures, counts=counts,
@@ -440,6 +597,10 @@ def print_scenario_result(result: ScenarioResult) -> None:
         print(f"   {name:>16s} admitted={t['admitted']:<6d} ok={t['done_ok']:<6d} "
               f"shed={shed:<5d} evict={evict:<5d} "
               f"p99={t['p99_s'] * 1e3:8.1f} ms")
+    for name, w in sorted(st.get("workers", {}).items()):
+        print(f"   worker {name:>9s} gen={w['gen']:<3d} served={w['served']:<6d} "
+              f"failures={w['failures']:<3d} "
+              f"circuit={'OPEN' if w['circuit_open'] else 'closed'}")
     for f in result.failures:
         print(f"   SLO FAIL: {f}")
     print(("PASS" if result.passed else "FAIL") + f"  scenario {scn.name}")

@@ -28,7 +28,7 @@ DEADLINE_EDGE_LADDER = (0.0, 0.05, 0.25, 1.0)
 
 _CURVE_SHAPES = ("constant", "diurnal", "burst")
 _ADVERSARIAL_MODES = (None, "quota_probe", "deadline_edge")
-_FAULT_KINDS = ("replica_down", "hang")
+_FAULT_KINDS = ("replica_down", "hang", "process_kill", "network_partition")
 
 
 @dataclass(frozen=True)
@@ -202,7 +202,17 @@ class FaultEvent:
     fault for ``seconds``.  ``replica_down`` forces ``target``'s watchdog
     probe to fail (``testing.faults.replica_down``) so the router's real
     drain/adopt path runs; ``hang`` injects a bounded-sync stall
-    (``testing.faults.hang``) long enough to blow the probe budget."""
+    (``testing.faults.hang``) long enough to blow the probe budget.
+
+    Fleet-mode faults (``--fleet`` runs): ``process_kill`` SIGKILLs
+    ``target``'s real worker OS process (``testing.faults.process_kill``;
+    ``seconds`` is ignored — the supervisor's backoff decides when the
+    replacement serves); ``network_partition`` blocks the parent→worker
+    wire to ``target`` for ``seconds`` (``testing.faults.
+    network_partition``).  In fleet mode a ``replica_down`` fault is
+    escalated to ``process_kill`` — an in-process probe patch cannot
+    cross a process boundary, and a real kill is the stronger version of
+    the same outage."""
 
     at_s: float
     kind: str = "replica_down"
@@ -213,8 +223,8 @@ class FaultEvent:
         if self.kind not in _FAULT_KINDS:
             raise ConfigurationError(
                 f"fault kind {self.kind!r} not in {_FAULT_KINDS}")
-        if self.kind == "replica_down" and not self.target:
-            raise ConfigurationError("replica_down fault needs a target replica")
+        if self.kind != "hang" and not self.target:
+            raise ConfigurationError(f"{self.kind} fault needs a target replica")
 
 
 @dataclass(frozen=True)
@@ -260,7 +270,7 @@ class Scenario:
                 f"scenario needs >= 1 replica and >= 1 request "
                 f"(replicas={self.replicas}, requests={self.requests})")
         for f in self.faults:
-            if f.kind == "replica_down" and \
+            if f.target is not None and \
                     f.target not in {f"replica{i}" for i in range(self.replicas)}:
                 raise ConfigurationError(
                     f"fault targets unknown replica {f.target!r} "
@@ -328,6 +338,27 @@ def library() -> dict:
                         "(p99 gate ~2x the locally observed burst-peak tail)",
         ),
         Scenario(
+            "burst_autoscale", seed=7, requests=400, linger_ms=100.0,
+            replicas=1,
+            tenants=(
+                TenantSpec("steady", share=0.5, max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+                TenantSpec("bursty", share=0.5, max_pending=512,
+                           expired_frac=0.02,
+                           arrival=ArrivalCurve("burst", rate=1.5,
+                                                period_s=4.0, duty=0.25,
+                                                burst_factor=6.0)),
+            ),
+            slo=SLO(min_ok_frac=0.85, max_shed_frac=0.15,
+                    zero_lost_admitted=True),
+            description="the burst load shape against an elastic fleet "
+                        "(run with fleet=True, autoscale=True): starts at "
+                        "one worker, must scale up under the bursts and "
+                        "back down after — the autoscale gate does the "
+                        "judging, so no p99 gate (worker spawns contend "
+                        "for CPU on small hosts)",
+        ),
+        Scenario(
             "diurnal", seed=13, requests=1000, linger_ms=100.0,
             tenants=(
                 TenantSpec("day", share=0.5, max_pending=256,
@@ -377,6 +408,28 @@ def library() -> dict:
             slo=SLO(min_ok_frac=0.85, p99_s=60.0, zero_lost_admitted=True),
             description="replica0 forced down mid-run via the watchdog "
                         "probe; the router drain/adopt path must lose zero "
+                        "admitted requests",
+        ),
+        Scenario(
+            "fleet_chaos", seed=37, requests=1000, linger_ms=100.0,
+            tenants=(
+                TenantSpec("steady", share=0.6, max_pending=512,
+                           arrival=ArrivalCurve("constant", rate=4.5)),
+                TenantSpec("interactive", share=0.4, lane=0, weight=2.0,
+                           max_pending=256,
+                           arrival=ArrivalCurve("constant", rate=3.0)),
+            ),
+            faults=(
+                FaultEvent(at_s=2.0, kind="process_kill", seconds=3.0,
+                           target="replica0"),
+                FaultEvent(at_s=8.0, kind="network_partition", seconds=1.5,
+                           target="replica1"),
+            ),
+            slo=SLO(min_ok_frac=0.85, zero_lost_admitted=True),
+            description="fleet-only (run with fleet=True): replica0 "
+                        "SIGKILLed mid-run, then replica1 partitioned from "
+                        "the supervisor for 1.5s — checkpoint-carried "
+                        "failover plus supervised respawn must lose zero "
                         "admitted requests",
         ),
         Scenario(

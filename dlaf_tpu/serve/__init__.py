@@ -21,6 +21,14 @@ Three pieces (see each module's docstring):
   buckets, weighted-fair lanes, deadline-aware eviction) routed across
   replicas (:class:`~dlaf_tpu.serve.router.Router` watchdog probes and
   drain-to-sibling failover).
+* :mod:`~dlaf_tpu.serve.wire` / :mod:`~dlaf_tpu.serve.worker` /
+  :mod:`~dlaf_tpu.serve.supervisor` / :mod:`~dlaf_tpu.serve.fleet` — the
+  v3 cross-process fleet: length-prefixed JSON-header wire frames with
+  binary array payloads, replica workers as separate OS processes (one
+  PJRT client each, warmup-at-spawn against a shared compile cache),
+  supervised restarts with backoff + crash-loop circuit breaker,
+  checkpoint-carried (HDF5) drain/adopt failover, and SLO-driven elastic
+  autoscaling (:class:`~dlaf_tpu.serve.fleet.Fleet`).
 """
 from dlaf_tpu.serve.batched import (
     batched_cholesky_factorization,
@@ -34,21 +42,33 @@ from dlaf_tpu.serve.bucketing import (
     default_cache,
 )
 from dlaf_tpu.serve.context import serve_trace_key, serving
+from dlaf_tpu.serve.fleet import Fleet
 from dlaf_tpu.serve.gateway import Gateway
 from dlaf_tpu.serve.pool import ServeResult, SolverPool, make_request
 from dlaf_tpu.serve.qos import FairQueue, TenantConfig, TokenBucket
 from dlaf_tpu.serve.router import Replica, Router
+from dlaf_tpu.serve.supervisor import (
+    Autoscaler,
+    Supervisor,
+    WireWatchdog,
+    WorkerHandle,
+)
 
 __all__ = [
+    "Autoscaler",
     "CompiledCache",
     "FairQueue",
+    "Fleet",
     "Gateway",
     "Replica",
     "Router",
     "ServeResult",
     "SolverPool",
+    "Supervisor",
     "TenantConfig",
     "TokenBucket",
+    "WireWatchdog",
+    "WorkerHandle",
     "batched_cholesky_factorization",
     "batched_eigensolver",
     "batched_positive_definite_solver",

@@ -133,6 +133,35 @@ class Router:
         with self._lock:
             self.get(name).healthy = True
 
+    # ------------------------------------------------------------- elastic
+
+    def add(self, replica: Replica) -> Replica:
+        """Bring a new replica into routing (fleet scale-up).  Name
+        uniqueness is enforced against the live set; the replica is
+        eligible for placement as soon as this returns."""
+        with self._lock:
+            if any(r.name == replica.name for r in self._replicas):
+                raise DistributionError(
+                    f"router: replica name {replica.name!r} already routed"
+                )
+            self._replicas = self._replicas + [replica]
+        return replica
+
+    def remove(self, name: str) -> Replica:
+        """Take a replica out of routing (fleet scale-down) and return it;
+        its queued requests are NOT migrated here — the caller drains the
+        returned replica's pool and re-adopts (the scale-down path does
+        exactly that).  The last replica cannot be removed: a router with
+        nothing to route to would strand every future the gateway holds."""
+        with self._lock:
+            if len(self._replicas) <= 1:
+                raise DistributionError(
+                    "router: cannot remove the last replica"
+                )
+            rep = self.get(name)
+            self._replicas = [r for r in self._replicas if r.name != name]
+        return rep
+
     # ----------------------------------------------------------- failover
 
     def check(self, probe_budget_s: float | None = None) -> dict:

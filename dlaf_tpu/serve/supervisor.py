@@ -1,0 +1,806 @@
+"""Fleet supervision: worker handles, wire watchdogs, restart policy,
+SLO-driven autoscaling.
+
+Three parent-side pieces over ``serve.wire``:
+
+* :class:`WorkerHandle` — the pool protocol (``pending`` / ``adopt`` /
+  ``drain`` / ``close``) spoken to one worker **process** over its control
+  socket.  Because the handle duck-types a ``SolverPool``, the whole v2
+  stack composes unchanged: ``Replica(name, handle)`` wraps it, the
+  :class:`~dlaf_tpu.serve.router.Router` probes/drains it, and the
+  :class:`~dlaf_tpu.serve.gateway.Gateway` dispatches into it — the
+  process boundary is invisible above this class.  Failover is
+  checkpoint-carried: ``drain`` round-trips the giving-back requests
+  through the HDF5 request checkpoint (worker-written when the socket is
+  live, parent-written when the worker is gone), never migrating
+  in-memory futures across the wire.
+
+* :class:`WireWatchdog` — ``resilience.DeviceWatchdog`` semantics over
+  the wire: ``probe()`` sends a probing heartbeat frame, the worker runs
+  its own device watchdog, and a missing/negative ack raises
+  :class:`~dlaf_tpu.health.DeviceUnresponsiveError` — so the router's
+  probe→down→drain→revive sweep works on processes exactly as it does on
+  in-process meshes.
+
+* :class:`Supervisor` — spawns workers (``multiprocessing`` spawn of
+  :func:`~dlaf_tpu.serve.worker.run_worker`, environment routed through
+  the child: compile cache dir, forced device count), health-checks them
+  (liveness heartbeats; a worker mute for ``serve_fleet_hang_restart_s``
+  while its process lives is hung), restarts with exponential backoff
+  (``serve_fleet_backoff_base_s`` doubling to ``_cap_s``) and a
+  crash-loop circuit breaker (``serve_fleet_crash_loop`` consecutive
+  failures opens the circuit — no more respawns), and collects child
+  flight dumps into the parent flight dir on every death.  Every
+  lifecycle step is a ``fleet`` record in the obs stream.
+
+:class:`Autoscaler` closes the loop: gateway p95/queue-depth signals in,
+sustained-signal hysteresis plus per-direction cooldowns, scale_up /
+scale_down callbacks out — every decision an obs ``fleet`` event carrying
+the signals that triggered it.
+"""
+from __future__ import annotations
+
+import os
+import re
+import signal as _signal
+import socket
+import threading
+import time
+
+from dlaf_tpu.health import DeviceUnresponsiveError, WireProtocolError
+from dlaf_tpu.obs import flight as oflight
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve import wire
+from dlaf_tpu.serve.pool import ServeResult
+
+#: one process-wide gate for the env-mutation window around Process.start()
+#: (spawned children inherit os.environ; concurrent spawns with different
+#: env would race).
+_SPAWN_ENV_LOCK = threading.Lock()
+
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def xla_flags_with_device_count(flags: str | None, n: int) -> str:
+    """Return ``flags`` with the forced host device count REPLACED by ``n``
+    (appended when absent) — the parent test harness pins its own count and
+    a naive append would lose to whichever flag XLA parses last."""
+    new = f"--xla_force_host_platform_device_count={int(n)}"
+    flags = flags or ""
+    if _DEVCOUNT_RE.search(flags):
+        return _DEVCOUNT_RE.sub(new, flags)
+    return f"{flags} {new}".strip()
+
+
+# ------------------------------------------------------------ worker handle
+
+
+class WorkerHandle:
+    """Parent-side pool protocol over one worker process's control socket.
+
+    One instance per fleet slot, living across restarts: each (re)spawn
+    bumps ``gen`` and attaches a fresh socket; the router's
+    :class:`~dlaf_tpu.serve.router.Replica` keeps pointing at the same
+    handle, so revival needs no router surgery.  ``outstanding`` maps wire
+    request ids to the parent-side requests (their client futures resolve
+    from ``result``/``error`` frames); a late result for an id already
+    drained away is dropped — first result wins, which is what makes
+    re-dispatching a partitioned worker's queue safe (solves are
+    idempotent)."""
+
+    def __init__(self, name: str, *, max_queue: int | None = None,
+                 ckpt_dir: str | None = None, fake: str | None = None,
+                 drain_timeout_s: float = 10.0):
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        self.name = str(name)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else p.serve_max_queue)
+        self.ckpt_dir = ckpt_dir
+        self.fake = fake
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.proc = None
+        self.pid: int | None = None
+        self.gen = 0
+        self.sock = None
+        self.alive = False          # wire-level: socket attached, no EOF yet
+        self.partitioned = False    # fault injection: parent->worker blocked
+        self.retired = False        # scale-down / close: no more adoptions
+        self.circuit_open = False
+        self.failures = 0           # consecutive deaths (backoff exponent)
+        self.restart_at: float | None = None
+        self.spawned_at = 0.0
+        self.last_ack = time.monotonic()
+        self.ready = threading.Event()
+        self.ready_info: dict = {}
+        self.served = 0             # results delivered to client futures
+        self.outstanding: dict = {}
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._seq = 0
+        self._hb_seq = 0
+        self._acks: dict = {}       # hb seq -> (Event, slot dict)
+        self._drains: dict = {}     # ckpt path -> (Event, slot dict)
+        self._drain_seq = 0
+
+    # -------------------------------------------------------------- wiring
+
+    def attach_socket(self, sock) -> None:
+        """Adopt a freshly-handshaken control socket (supervisor accept
+        loop) and start this incarnation's reader thread."""
+        self.sock = sock
+        self.partitioned = False
+        self.alive = True
+        self.last_ack = time.monotonic()
+        threading.Thread(target=self._read_loop, args=(sock, self.gen),
+                         name=f"dlaf-fleet-rx-{self.name}", daemon=True).start()
+
+    def _send(self, msg: dict, arrays: dict | None = None) -> None:
+        if self.partitioned:
+            raise OSError(f"fleet: network partition to worker {self.name} "
+                          f"(simulated)")
+        sock = self.sock
+        if sock is None or not self.alive:
+            raise OSError(f"fleet: worker {self.name} has no live connection")
+        with self._send_lock:
+            # dlaf: ignore[DLAF004] frame writes to one worker must serialize
+            # on its socket; sendall is the transport, not deferred work
+            wire.send_frame(sock, msg, arrays)
+
+    def _read_loop(self, sock, gen: int) -> None:
+        try:
+            while True:
+                frame = wire.recv_frame(sock)
+                if frame is None:
+                    break
+                msg, arrays = frame
+                op = msg.get("op")
+                if op == "result":
+                    self._on_result(msg, arrays)
+                elif op == "error":
+                    self._on_error(msg)
+                elif op == "heartbeat_ack":
+                    self._on_ack(msg)
+                elif op == "ready":
+                    self.ready_info = dict(msg)
+                    warm = dict(msg.get("warm") or {})
+                    om.emit("fleet", event="worker_ready", worker=self.name,
+                            pid=msg.get("pid"), gen=self.gen,
+                            warm_plans=warm.get("plans", 0),
+                            warm_compiles=warm.get("compiles", 0),
+                            warm_aot_loads=warm.get("aot_loads", 0),
+                            warm_seconds=warm.get("seconds", 0.0))
+                    self.ready.set()
+                elif op == "drained":
+                    self._on_drained(msg)
+                elif op == "bye":
+                    break
+        except (WireProtocolError, OSError):
+            pass
+        finally:
+            if self.gen == gen:
+                self.alive = False
+            with self._lock:
+                waiters = list(self._acks.values()) + list(self._drains.values())
+            for evt, _ in waiters:     # fail waiters fast, not by timeout
+                evt.set()
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ frame handlers
+
+    def _on_result(self, msg: dict, arrays: dict) -> None:
+        with self._lock:
+            req = self.outstanding.pop(msg.get("id"), None)
+        if req is None:
+            return  # re-dispatched elsewhere meanwhile: first result won
+        self.served += 1
+        res = ServeResult(
+            kind=msg.get("kind"), info=int(msg.get("info", 0)),
+            queue_s=float(msg.get("queue_s", 0.0)),
+            x=arrays.get("x"), w=arrays.get("w"), v=arrays.get("v"),
+        )
+        if not req.future.done():
+            try:
+                req.future.set_result(res)
+            except Exception:  # noqa: BLE001 - lost a set race: result stands
+                pass
+
+    def _on_error(self, msg: dict) -> None:
+        with self._lock:
+            req = self.outstanding.pop(msg.get("id"), None)
+        if req is None:
+            return
+        exc = wire.rebuild_error(msg.get("error", "RuntimeError"),
+                                 msg.get("message", ""), msg.get("fields"))
+        if not req.future.done():
+            try:
+                req.future.set_exception(exc)
+            except Exception:  # noqa: BLE001 - lost a set race
+                pass
+
+    def _on_ack(self, msg: dict) -> None:
+        self.last_ack = time.monotonic()
+        with self._lock:
+            pair = self._acks.pop(msg.get("seq"), None)
+        if pair is not None:
+            evt, slot = pair
+            slot.update(msg)
+            evt.set()
+
+    def _on_drained(self, msg: dict) -> None:
+        with self._lock:
+            pair = self._drains.get(msg.get("ckpt"))
+        if pair is not None:
+            evt, slot = pair
+            slot.update(msg)
+            evt.set()
+
+    # ----------------------------------------------------------- heartbeat
+
+    def heartbeat(self, *, probe: bool = False, budget_s: float | None = None,
+                  timeout: float = 5.0) -> dict:
+        """Send one heartbeat frame and wait (bounded) for its ack.
+
+        Returns the ack payload (``ok`` / ``pending`` / ``probe_s``);
+        raises :class:`DeviceUnresponsiveError` when no ack lands within
+        ``timeout`` and ``OSError`` when the send itself cannot leave
+        (dead socket, simulated partition)."""
+        with self._lock:
+            self._hb_seq += 1
+            seq = self._hb_seq
+            evt, slot = threading.Event(), {}
+            self._acks[seq] = (evt, slot)
+        try:
+            self._send({"op": "heartbeat", "seq": seq, "probe": bool(probe),
+                        "budget_s": budget_s})
+            evt.wait(timeout)
+        finally:
+            with self._lock:
+                self._acks.pop(seq, None)
+        if "ok" not in slot:
+            raise DeviceUnresponsiveError(
+                float(timeout), device=self.name,
+                message=(f"fleet: worker {self.name} did not ack heartbeat "
+                         f"{seq} within {timeout:g} s"),
+            )
+        return slot
+
+    # -------------------------------------------------------- pool protocol
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self.outstanding)
+
+    def adopt(self, reqs) -> list:
+        """Serialize requests to the worker, keeping order; on any refusal
+        (retired handle, dead/partitioned socket, queue bound) the
+        untransmitted tail comes back, exactly like ``SolverPool.adopt``."""
+        reqs = list(reqs)
+        for i, req in enumerate(reqs):
+            with self._lock:
+                if (self.retired or self.circuit_open or not self.alive
+                        or len(self.outstanding) >= self.max_queue):
+                    return reqs[i:]
+                self._seq += 1
+                rid = f"{self.name}.g{self.gen}:{self._seq}"
+                self.outstanding[rid] = req
+            req._wire_id = rid
+            now = time.monotonic()
+            msg = {"op": "submit", "id": rid, "kind": req.kind,
+                   "uplo": req.uplo, "squeeze": bool(req.squeeze),
+                   "deadline_rem_s": req.remaining(),
+                   "age_s": max(now - req.t_submit, 0.0)}
+            arrays = {"a": req.a}
+            if req.b is not None:
+                arrays["b"] = req.b
+            try:
+                self._send(msg, arrays)
+            except OSError:
+                with self._lock:
+                    self.outstanding.pop(rid, None)
+                return reqs[i:]
+        return []
+
+    def _ckpt_path(self) -> str:
+        self._drain_seq += 1
+        base = self.ckpt_dir or "."
+        os.makedirs(base, exist_ok=True)
+        return os.path.join(
+            base, f"drain-{self.name}-g{self.gen}-{self._drain_seq}.h5"
+        )
+
+    def drain(self) -> list:
+        """Give back requests for sibling re-dispatch, carried over the
+        HDF5 request checkpoint.
+
+        Live socket (graceful): the worker checkpoints its queued-but-
+        undispatched requests and answers with their ids; the parent loads
+        the checkpoint, matches ids against ``outstanding`` and returns
+        the original requests (client futures intact) with their operands
+        refreshed from the checkpoint.  Work already dispatched into a
+        batch stays with the worker and streams back normally.
+
+        Dead/partitioned worker: nothing can be asked, so EVERY
+        outstanding request is checkpointed parent-side, reloaded, and
+        returned — a request the worker does complete later is dropped by
+        first-result-wins."""
+        ckpt = self._ckpt_path()
+        with self._lock:
+            evt, slot = threading.Event(), {}
+            self._drains[ckpt] = (evt, slot)
+        try:
+            self._send({"op": "drain", "ckpt": ckpt})
+            evt.wait(self.drain_timeout_s)
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                self._drains.pop(ckpt, None)
+        if "count" not in slot:
+            return self._drain_dead(ckpt)
+        entries = wire.load_request_checkpoint(ckpt) if slot["count"] else []
+        out = self._match_entries(entries)
+        om.emit("fleet", event="failover_drain", worker=self.name,
+                mode="graceful", count=len(out), ckpt=ckpt)
+        return out
+
+    def _drain_dead(self, ckpt: str) -> list:
+        with self._lock:
+            items = list(self.outstanding.items())
+            self.outstanding.clear()
+        now = time.monotonic()
+        entries = [{
+            "id": rid, "kind": r.kind, "uplo": r.uplo, "squeeze": r.squeeze,
+            "deadline_rem_s": r.remaining(), "age_s": now - r.t_submit,
+            "a": r.a, "b": r.b,
+        } for rid, r in items]
+        wire.save_request_checkpoint(ckpt, entries)
+        out = self._match_entries(wire.load_request_checkpoint(ckpt),
+                                  pool=dict(items))
+        om.emit("fleet", event="failover_drain", worker=self.name,
+                mode="dead", count=len(out), ckpt=ckpt)
+        return out
+
+    def _match_entries(self, entries: list, pool: dict | None = None) -> list:
+        """Map checkpoint entries back to parent requests by wire id,
+        refreshing operands from the checkpoint (the HDF5 copy is the
+        failover payload, not just an audit artifact)."""
+        out = []
+        for e in entries:
+            if pool is not None:
+                req = pool.get(e["id"])
+            else:
+                with self._lock:
+                    req = self.outstanding.pop(e["id"], None)
+            if req is None:
+                continue
+            req.a, req.b = e["a"], e["b"]
+            out.append(req)
+        return out
+
+    def kill(self, sig: int = _signal.SIGKILL) -> None:
+        """Hard-kill the worker process (fault injection / hung cleanup)."""
+        pid = self.pid
+        if pid:
+            try:
+                os.kill(pid, sig)
+            except (OSError, ProcessLookupError):
+                pass
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Retire the slot: graceful shutdown frame, bounded join, then
+        terminate whatever is left."""
+        self.retired = True
+        try:
+            self._send({"op": "shutdown"})
+        except OSError:
+            pass
+        proc = self.proc
+        if proc is not None:
+            try:
+                proc.join(timeout)
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(2.0)
+            except (ValueError, OSError, AssertionError):
+                pass
+        self.alive = False
+
+
+class WireWatchdog:
+    """Device-watchdog semantics over the wire (router-compatible:
+    ``probe(budget_s)`` + ``budget_s``).  The probe is one probing
+    heartbeat; the worker runs its own ``resilience.DeviceWatchdog``
+    against its own mesh and the verdict rides the ack."""
+
+    def __init__(self, handle: WorkerHandle, budget_s: float = 5.0):
+        self.handle = handle
+        self.budget_s = float(budget_s)
+
+    def probe(self, budget_s: float | None = None) -> float:
+        budget = float(budget_s if budget_s is not None else self.budget_s)
+        t0 = time.monotonic()
+        try:
+            ack = self.handle.heartbeat(probe=True, budget_s=budget,
+                                        timeout=budget)
+        except OSError as exc:
+            raise DeviceUnresponsiveError(
+                budget, device=self.handle.name,
+                message=(f"fleet: worker {self.handle.name} unreachable "
+                         f"({exc})"),
+            ) from exc
+        if not ack.get("ok", False):
+            raise DeviceUnresponsiveError(
+                budget, device=self.handle.name,
+                message=(f"fleet: worker {self.handle.name} failed its "
+                         f"device probe worker-side"),
+            )
+        return time.monotonic() - t0
+
+
+# --------------------------------------------------------------- supervisor
+
+
+class Supervisor:
+    """Spawn, health-check, restart, and retire fleet workers.
+
+    ``worker_args(handle)`` (injectable) returns the kwargs for
+    :func:`~dlaf_tpu.serve.worker.run_worker`; ``env`` is merged into the
+    child environment for the spawn window.  ``on_worker_dead(handle)``
+    fires synchronously when a death/hang is detected — BEFORE the backoff
+    respawn is scheduled — so the fleet can drain the handle and re-dispatch
+    its outstanding work while the slot is down."""
+
+    def __init__(self, *, base_dir: str, env: dict | None = None,
+                 worker_kwargs: dict | None = None,
+                 heartbeat_s: float | None = None,
+                 backoff_base_s: float | None = None,
+                 backoff_cap_s: float | None = None,
+                 crash_loop: int | None = None,
+                 hang_restart_s: float | None = None,
+                 flight_dir: str | None = None,
+                 on_worker_dead=None):
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        self.base_dir = str(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.env = dict(env or {})
+        self.worker_kwargs = dict(worker_kwargs or {})
+        self.heartbeat_s = float(heartbeat_s if heartbeat_s is not None
+                                 else p.serve_fleet_heartbeat_s)
+        self.backoff_base_s = float(backoff_base_s if backoff_base_s is not None
+                                    else p.serve_fleet_backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s if backoff_cap_s is not None
+                                   else p.serve_fleet_backoff_cap_s)
+        self.crash_loop = int(crash_loop if crash_loop is not None
+                              else p.serve_fleet_crash_loop)
+        self.hang_restart_s = float(hang_restart_s if hang_restart_s is not None
+                                    else p.serve_fleet_hang_restart_s)
+        self.flight_dir = flight_dir or os.path.join(self.base_dir, "flight")
+        os.makedirs(self.flight_dir, exist_ok=True)
+        self.on_worker_dead = on_worker_dead
+        self._handles: dict[str, WorkerHandle] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.address = self._listener.getsockname()[:2]
+        threading.Thread(target=self._accept_loop,
+                         name="dlaf-fleet-accept", daemon=True).start()
+        self._monitor = None
+
+    # ------------------------------------------------------------- handles
+
+    def handles(self) -> list[WorkerHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def get(self, name: str) -> WorkerHandle | None:
+        with self._lock:
+            return self._handles.get(name)
+
+    def add_handle(self, handle: WorkerHandle) -> WorkerHandle:
+        with self._lock:
+            if handle.name in self._handles:
+                raise ValueError(f"fleet: duplicate worker name {handle.name!r}")
+            self._handles[handle.name] = handle
+        if handle.ckpt_dir is None:
+            handle.ckpt_dir = os.path.join(self.base_dir, "ckpt")
+        return handle
+
+    def remove_handle(self, name: str) -> WorkerHandle | None:
+        with self._lock:
+            return self._handles.pop(name, None)
+
+    # --------------------------------------------------------------- spawn
+
+    def worker_flight_dir(self, handle: WorkerHandle) -> str:
+        return os.path.join(self.base_dir, "child-flight", handle.name)
+
+    def spawn(self, handle: WorkerHandle) -> None:
+        """(Re)spawn the worker process for ``handle``: new generation,
+        fresh ready event, environment routed through the spawn window."""
+        from multiprocessing import get_context
+
+        from dlaf_tpu.serve import worker as worker_mod
+
+        handle.gen += 1
+        handle.ready = threading.Event()
+        handle.ready_info = {}
+        handle.restart_at = None
+        host, port = self.address
+        kwargs = dict(self.worker_kwargs)
+        kwargs.setdefault("flight_dir", self.worker_flight_dir(handle))
+        kwargs.setdefault(
+            "metrics_out",
+            os.path.join(self.base_dir, f"worker-{handle.name}-g{handle.gen}.jsonl"),
+        )
+        if handle.fake:
+            kwargs["fake"] = handle.fake
+        ctx = get_context("spawn")
+        proc = ctx.Process(
+            target=worker_mod.run_worker, args=(host, port, handle.name),
+            kwargs=kwargs, daemon=True, name=f"dlaf-fleet-{handle.name}",
+        )
+        env = {k: str(v) for k, v in self.env.items()}
+        with _SPAWN_ENV_LOCK:
+            saved = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            try:
+                proc.start()
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+        handle.proc, handle.pid = proc, proc.pid
+        handle.spawned_at = time.monotonic()
+        handle.last_ack = time.monotonic()
+        om.emit("fleet", event="worker_spawn", worker=handle.name,
+                pid=proc.pid, gen=handle.gen, failures=handle.failures)
+
+    def wait_ready(self, handle: WorkerHandle, timeout: float = 300.0) -> dict:
+        """Block until the worker's ``ready`` frame (post-warmup); the
+        ``worker_ready`` fleet event — with the compile/AOT-load
+        attribution — is emitted by the handle's read loop when the frame
+        lands, so monitor respawns (which never block here) are covered
+        too."""
+        if not handle.ready.wait(timeout):
+            raise DeviceUnresponsiveError(
+                float(timeout), device=handle.name,
+                message=(f"fleet: worker {handle.name} not ready within "
+                         f"{timeout:g} s"),
+            )
+        return dict(handle.ready_info.get("warm") or {})
+
+    # ----------------------------------------------------- accept handshake
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handshake, args=(sock,),
+                             name="dlaf-fleet-hello", daemon=True).start()
+
+    def _handshake(self, sock) -> None:
+        try:
+            sock.settimeout(30.0)
+            frame = wire.recv_frame(sock)
+            if frame is None:
+                sock.close()
+                return
+            msg, _ = frame
+            handle = (self.get(msg.get("name"))
+                      if msg.get("op") == "hello" else None)
+            if handle is None:
+                sock.close()
+                return
+            sock.settimeout(None)
+            handle.attach_socket(sock)
+            om.emit("fleet", event="worker_hello", worker=handle.name,
+                    pid=msg.get("pid"), gen=handle.gen)
+        except (WireProtocolError, OSError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- monitor
+
+    def start_monitor(self) -> None:
+        if self._monitor is not None:
+            return
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="dlaf-fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            time.sleep(min(self.heartbeat_s, 0.25))
+            try:
+                self.monitor_step()
+            except Exception:  # noqa: BLE001 - the monitor must not die
+                oflight.auto_dump("fleet_monitor_error")
+
+    def monitor_step(self, now: float | None = None) -> None:
+        """One supervision pass: liveness heartbeats, death/hang detection,
+        backoff respawns.  Also callable directly (tests, fleet loop)."""
+        now = time.monotonic() if now is None else now
+        for handle in self.handles():
+            if handle.retired or handle.circuit_open:
+                continue
+            if handle.restart_at is not None:
+                if now >= handle.restart_at:
+                    self.spawn(handle)
+                continue
+            proc = handle.proc
+            if proc is None:
+                continue
+            dead = not proc.is_alive()
+            if not dead and handle.alive:
+                try:
+                    handle.heartbeat(probe=False, timeout=self.heartbeat_s)
+                except (OSError, DeviceUnresponsiveError):
+                    pass  # missed ack: the hang clock (last_ack) is running
+                if handle.failures and (
+                        now - handle.spawned_at > self.backoff_cap_s):
+                    handle.failures = 0  # stable past the cap: streak over
+            hung = (not dead and handle.ready.is_set()
+                    and now - handle.last_ack > self.hang_restart_s)
+            if dead or hung:
+                self._on_failure(handle, "exit" if dead else "hung", now)
+
+    def _on_failure(self, handle: WorkerHandle, reason: str, now: float) -> None:
+        handle.alive = False
+        exitcode = getattr(handle.proc, "exitcode", None)
+        if reason == "hung":
+            handle.kill()
+        proc = handle.proc
+        if proc is not None:
+            try:
+                proc.join(5.0)
+            except (ValueError, AssertionError):
+                pass
+        self.collect_flight_dumps(handle)
+        handle.failures += 1
+        om.emit("fleet", event="worker_exit", worker=handle.name,
+                reason=reason, pid=handle.pid, exitcode=exitcode,
+                gen=handle.gen, failures=handle.failures)
+        if self.on_worker_dead is not None:
+            try:
+                self.on_worker_dead(handle)
+            except Exception:  # noqa: BLE001 - supervision must continue
+                oflight.auto_dump("fleet_on_dead_error")
+        if handle.failures >= self.crash_loop:
+            handle.circuit_open = True
+            om.emit("fleet", event="circuit_open", worker=handle.name,
+                    failures=handle.failures, gen=handle.gen)
+            return
+        backoff = min(self.backoff_cap_s,
+                      self.backoff_base_s * (2 ** (handle.failures - 1)))
+        handle.restart_at = now + backoff
+        om.emit("fleet", event="worker_restart", worker=handle.name,
+                backoff_s=backoff, failures=handle.failures, gen=handle.gen)
+
+    def collect_flight_dumps(self, handle: WorkerHandle) -> list:
+        """Pull the dead worker's ``flight_*.json`` files into the parent
+        flight dir, stamped with the worker id (satellite evidence trail:
+        a killed replica's last seconds survive it)."""
+        copied = oflight.collect(self.worker_flight_dir(handle),
+                                 self.flight_dir,
+                                 tag=f"{handle.name}-g{handle.gen}")
+        if copied:
+            om.emit("fleet", event="flight_collected", worker=handle.name,
+                    gen=handle.gen, count=len(copied), paths=copied)
+        return copied
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        mon = self._monitor
+        if mon is not None:
+            mon.join(timeout=5.0)
+        for handle in self.handles():
+            handle.close()
+
+
+# --------------------------------------------------------------- autoscaler
+
+
+class Autoscaler:
+    """SLO-driven worker-count controller with hysteresis.
+
+    Pure decision logic over injected functions, so tests drive it with
+    synthetic clocks and signals: ``signal_fn() -> (p95_s, queued)``,
+    ``count_fn() -> live workers``, ``scale_up()`` / ``scale_down()`` do
+    the actual fleet surgery.  A direction must be signalled ``sustain``
+    consecutive steps AND be outside both its own cooldown and the
+    opposite direction's before it fires (the anti-flap contract the
+    diurnal test asserts).  Every decision lands in ``self.actions`` and
+    as an obs ``fleet`` event with the triggering signals."""
+
+    def __init__(self, signal_fn, count_fn, scale_up, scale_down, *,
+                 min_workers: int = 1, max_workers: int = 4,
+                 sustain: int = 3,
+                 up_p95_s: float | None = None, up_queue: int | None = None,
+                 down_queue: int | None = None,
+                 up_cooldown_s: float | None = None,
+                 down_cooldown_s: float | None = None):
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        self.signal_fn = signal_fn
+        self.count_fn = count_fn
+        self.scale_up = scale_up
+        self.scale_down = scale_down
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.sustain = max(int(sustain), 1)
+        self.up_p95_s = float(up_p95_s if up_p95_s is not None
+                              else p.serve_fleet_scale_up_p95_s)
+        self.up_queue = int(up_queue if up_queue is not None
+                            else p.serve_fleet_scale_up_queue)
+        self.down_queue = int(down_queue if down_queue is not None
+                              else p.serve_fleet_scale_down_queue)
+        self.up_cooldown_s = float(up_cooldown_s if up_cooldown_s is not None
+                                   else p.serve_fleet_scale_up_cooldown_s)
+        self.down_cooldown_s = float(
+            down_cooldown_s if down_cooldown_s is not None
+            else p.serve_fleet_scale_down_cooldown_s)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up = -1e18
+        self._last_down = -1e18
+        self.actions: list = []
+
+    def step(self, now: float | None = None) -> str | None:
+        """Evaluate the signals once; returns ``"scale_up"`` /
+        ``"scale_down"`` when a decision fired, else None."""
+        now = time.monotonic() if now is None else float(now)
+        p95, queued = self.signal_fn()
+        n = int(self.count_fn())
+        # the p95 signal only counts as hot while work is actually queued:
+        # gateway percentiles are cumulative over the run, so a past
+        # overload ratchets them up permanently — without the queue guard
+        # a drained fleet would read as hot forever (scale-down would
+        # never fire, and an idle fleet would grow to max on stale p95)
+        hot = queued >= self.up_queue or (
+            p95 > self.up_p95_s and queued > self.down_queue)
+        cold = (not hot) and queued <= self.down_queue
+        self._up_streak = self._up_streak + 1 if hot else 0
+        self._down_streak = self._down_streak + 1 if cold else 0
+        decision = None
+        if (hot and self._up_streak >= self.sustain and n < self.max_workers
+                and now - self._last_up >= self.up_cooldown_s
+                and now - self._last_down >= self.up_cooldown_s):
+            self._last_up = now
+            self._up_streak = 0
+            decision = "scale_up"
+        elif (cold and self._down_streak >= self.sustain
+                and n > self.min_workers
+                and now - self._last_down >= self.down_cooldown_s
+                and now - self._last_up >= self.down_cooldown_s):
+            self._last_down = now
+            self._down_streak = 0
+            decision = "scale_down"
+        if decision is None:
+            return None
+        self.actions.append({"t": now, "action": decision, "p95_s": p95,
+                             "queued": queued, "workers": n})
+        om.emit("fleet", event=decision, p95_s=p95, queued=queued,
+                workers=n, sustain=self.sustain)
+        (self.scale_up if decision == "scale_up" else self.scale_down)()
+        return decision

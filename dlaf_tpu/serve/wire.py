@@ -1,0 +1,570 @@
+"""Serve fleet wire protocol: length-prefixed JSON frames + array payloads.
+
+One frame = ``b"DWF1" | u32 header_len | u32 payload_len | header | payload``
+(lengths big-endian).  The header is UTF-8 JSON: ``{"msg": {...},
+"arrays": [{"name", "dtype", "shape", "offset", "nbytes"}, ...]}``; the
+payload is the raw C-contiguous bytes of every array, concatenated at the
+listed offsets.  JSON (not msgpack) keeps the frame layer dependency-free;
+array bytes never round-trip through JSON, so the encoding overhead per
+request is one small header, not a base64 blow-up.
+
+The same framing runs over BOTH fleet transports:
+
+* the supervisor <-> worker control channel (blocking sockets,
+  :func:`send_frame` / :func:`recv_frame` — supervisor reader threads and
+  the worker's main loop);
+* the public gateway edge (:class:`GatewayServer` /
+  :class:`GatewayClient`, asyncio streams over localhost TCP or a unix
+  socket) — ``Gateway.submit`` behind a real wire, streaming: many
+  requests in flight per connection, responses demultiplexed by id.
+
+Violations reject with :class:`~dlaf_tpu.health.WireProtocolError` carrying
+a machine-stable ``reason`` (``magic`` / ``oversize`` / ``truncated`` /
+``header`` / ``array``); a clean EOF *between* frames reads as ``None``.
+The frame bound defaults from ``tune.serve_fleet_max_frame_mb`` — an
+unauthenticated peer must not be able to make a reader allocate
+gigabytes off a forged length prefix.
+
+Failover state rides HDF5, not frames: :func:`save_request_checkpoint` /
+:func:`load_request_checkpoint` persist a drained worker's
+queued-but-undispatched requests (operands + admission state: deadline
+remaining, queue age) through the same atomic tmp+rename pattern as
+``resilience.save_checkpoint``, so the supervisor's drain/adopt handshake
+re-routes requests from a disk artifact — no in-memory future migration
+across processes.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import numpy as np
+
+from dlaf_tpu.health import (
+    ConfigurationError,
+    ConvergenceError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    DistributionError,
+    NonFiniteError,
+    NotPositiveDefiniteError,
+    QueueFullError,
+    RemoteWorkerError,
+    TenantQuotaExceededError,
+    WireProtocolError,
+)
+
+MAGIC = b"DWF1"
+_PREFIX = struct.Struct(">II")
+PREFIX_LEN = len(MAGIC) + _PREFIX.size
+
+#: request-checkpoint HDF5 schema tag (see :func:`save_request_checkpoint`).
+REQ_CKPT_SCHEMA = "dlaf_tpu.reqckpt/1"
+
+
+def max_frame_bytes() -> int:
+    """The frame bound in effect (``tune.serve_fleet_max_frame_mb``)."""
+    from dlaf_tpu.tune import get_tune_parameters
+
+    return int(get_tune_parameters().serve_fleet_max_frame_mb * 1024 * 1024)
+
+
+def _bound(max_bytes: int | None) -> int:
+    return int(max_bytes) if max_bytes is not None else max_frame_bytes()
+
+
+# ---------------------------------------------------------------- encoding
+
+
+def encode_frame(msg: dict, arrays: dict | None = None,
+                 *, max_bytes: int | None = None) -> bytes:
+    """One wire frame for ``msg`` (JSON-serializable dict) plus named
+    ``arrays`` ({name: ndarray}); raises :class:`WireProtocolError`
+    (``oversize``) beyond the frame bound."""
+    descs = []
+    chunks = []
+    offset = 0
+    for name, arr in (arrays or {}).items():
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.hasobject:
+            raise WireProtocolError(
+                "array", f"array {name!r} has object dtype {arr.dtype}")
+        raw = arr.tobytes()
+        descs.append({"name": str(name), "dtype": arr.dtype.str,
+                      "shape": list(arr.shape), "offset": offset,
+                      "nbytes": len(raw)})
+        chunks.append(raw)
+        offset += len(raw)
+    header = json.dumps({"msg": msg, "arrays": descs}).encode()
+    total = PREFIX_LEN + len(header) + offset
+    limit = _bound(max_bytes)
+    if total > limit:
+        raise WireProtocolError(
+            "oversize",
+            f"frame of {total} bytes exceeds the {limit}-byte bound "
+            f"(tune.serve_fleet_max_frame_mb)")
+    return b"".join([MAGIC, _PREFIX.pack(len(header), offset), header] + chunks)
+
+
+def _decode_parts(header: bytes, payload: bytes) -> tuple:
+    try:
+        doc = json.loads(header.decode())
+        msg = doc["msg"]
+        descs = doc.get("arrays", [])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError) as exc:
+        raise WireProtocolError(
+            "header", f"frame header is not valid JSON: {exc}") from exc
+    arrays = {}
+    for d in descs:
+        try:
+            dt = np.dtype(d["dtype"])
+            if dt.hasobject:
+                raise TypeError("object dtype")
+            off, nb = int(d["offset"]), int(d["nbytes"])
+            if off < 0 or nb < 0 or off + nb > len(payload):
+                raise ValueError(f"array bytes [{off}:{off + nb}] outside "
+                                 f"payload of {len(payload)}")
+            arr = np.frombuffer(payload, dtype=dt, count=nb // dt.itemsize,
+                                offset=off).reshape(d["shape"])
+        except (ValueError, TypeError, KeyError) as exc:
+            raise WireProtocolError(
+                "array", f"bad array descriptor {d!r}: {exc}") from exc
+        arrays[str(d["name"])] = arr.copy()  # writable, payload released
+    return msg, arrays
+
+
+def decode_frame(buf: bytes, *, max_bytes: int | None = None) -> tuple:
+    """Decode one complete frame from ``buf``; returns ``(msg, arrays)``.
+    Typed rejection: ``magic`` / ``oversize`` / ``truncated`` / ``header``
+    / ``array``."""
+    if len(buf) < PREFIX_LEN:
+        raise WireProtocolError(
+            "truncated", f"frame prefix needs {PREFIX_LEN} bytes, got {len(buf)}")
+    if buf[:len(MAGIC)] != MAGIC:
+        raise WireProtocolError(
+            "magic", f"bad frame magic {bytes(buf[:len(MAGIC)])!r}")
+    hl, pl = _PREFIX.unpack_from(buf, len(MAGIC))
+    limit = _bound(max_bytes)
+    if PREFIX_LEN + hl + pl > limit:
+        raise WireProtocolError(
+            "oversize", f"frame of {PREFIX_LEN + hl + pl} bytes exceeds the "
+                        f"{limit}-byte bound")
+    if len(buf) != PREFIX_LEN + hl + pl:
+        raise WireProtocolError(
+            "truncated", f"frame declares {PREFIX_LEN + hl + pl} bytes, "
+                         f"got {len(buf)}")
+    return _decode_parts(buf[PREFIX_LEN:PREFIX_LEN + hl],
+                         buf[PREFIX_LEN + hl:])
+
+
+# --------------------------------------------- blocking-socket transport
+
+
+def send_frame(sock, msg: dict, arrays: dict | None = None,
+               *, max_bytes: int | None = None) -> None:
+    """Write one frame on a blocking socket (supervisor <-> worker)."""
+    sock.sendall(encode_frame(msg, arrays, max_bytes=max_bytes))
+
+
+def _recv_exact(sock, n: int) -> bytes | None:
+    """Exactly ``n`` bytes, or None on EOF at a clean boundary (0 bytes);
+    EOF mid-read raises ``truncated``."""
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise WireProtocolError(
+                "truncated", f"peer closed mid-frame ({got}/{n} bytes)")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def recv_frame(sock, *, max_bytes: int | None = None) -> tuple | None:
+    """Read one frame from a blocking socket; ``None`` on clean EOF."""
+    prefix = _recv_exact(sock, PREFIX_LEN)
+    if prefix is None:
+        return None
+    if prefix[:len(MAGIC)] != MAGIC:
+        raise WireProtocolError("magic", f"bad frame magic {prefix[:len(MAGIC)]!r}")
+    hl, pl = _PREFIX.unpack_from(prefix, len(MAGIC))
+    limit = _bound(max_bytes)
+    if PREFIX_LEN + hl + pl > limit:
+        raise WireProtocolError(
+            "oversize", f"frame of {PREFIX_LEN + hl + pl} bytes exceeds the "
+                        f"{limit}-byte bound")
+    header = _recv_exact(sock, hl)
+    payload = _recv_exact(sock, pl) if pl else b""
+    if header is None or payload is None:
+        raise WireProtocolError("truncated", "peer closed mid-frame")
+    return _decode_parts(header, payload)
+
+
+# -------------------------------------------------- asyncio-stream transport
+
+
+async def aread_frame(reader: asyncio.StreamReader,
+                      *, max_bytes: int | None = None) -> tuple | None:
+    """Read one frame from an asyncio stream; ``None`` on clean EOF."""
+    try:
+        prefix = await reader.readexactly(PREFIX_LEN)
+    except asyncio.IncompleteReadError as exc:
+        if not exc.partial:
+            return None
+        raise WireProtocolError(
+            "truncated",
+            f"peer closed mid-prefix ({len(exc.partial)}/{PREFIX_LEN} bytes)",
+        ) from exc
+    if prefix[:len(MAGIC)] != MAGIC:
+        raise WireProtocolError("magic", f"bad frame magic {prefix[:len(MAGIC)]!r}")
+    hl, pl = _PREFIX.unpack_from(prefix, len(MAGIC))
+    limit = _bound(max_bytes)
+    if PREFIX_LEN + hl + pl > limit:
+        raise WireProtocolError(
+            "oversize", f"frame of {PREFIX_LEN + hl + pl} bytes exceeds the "
+                        f"{limit}-byte bound")
+    try:
+        body = await reader.readexactly(hl + pl)
+    except asyncio.IncompleteReadError as exc:
+        raise WireProtocolError("truncated", "peer closed mid-frame") from exc
+    return _decode_parts(body[:hl], body[hl:])
+
+
+async def awrite_frame(writer: asyncio.StreamWriter, msg: dict,
+                       arrays: dict | None = None,
+                       *, max_bytes: int | None = None) -> None:
+    writer.write(encode_frame(msg, arrays, max_bytes=max_bytes))
+    await writer.drain()
+
+
+# -------------------------------------------------- typed errors over frames
+
+#: taxonomy errors a worker can report typed; anything else rebuilds as
+#: RemoteWorkerError so the parent never loses the class name.
+_ERROR_ATTRS = ("size", "capacity", "tenant", "rate", "budget_s", "label",
+                "device", "info", "stage", "reason", "remote_type")
+
+
+def error_fields(exc: BaseException) -> dict:
+    """Wire representation of an exception: class name, message, and the
+    taxonomy attrs a typed rebuild needs."""
+    fields = {}
+    for attr in _ERROR_ATTRS:
+        v = getattr(exc, attr, None)
+        if isinstance(v, (int, float, str, bool)):
+            fields[attr] = v
+    return {"error": type(exc).__name__, "message": str(exc), "fields": fields}
+
+
+def rebuild_error(name: str, message: str, fields: dict | None = None) -> BaseException:
+    """The parent-side exception for a worker-reported failure: known
+    taxonomy names rebuild with their real constructors (so
+    ``except QueueFullError`` works across the process boundary), unknown
+    names become :class:`RemoteWorkerError`."""
+    f = fields or {}
+    if name == "TenantQuotaExceededError":
+        return TenantQuotaExceededError(
+            f.get("tenant", "?"), float(f.get("rate", 0.0)), message)
+    if name == "QueueFullError":
+        return QueueFullError(
+            int(f.get("size", 0)), int(f.get("capacity", 0)), message)
+    if name == "DeadlineExceededError":
+        return DeadlineExceededError(
+            float(f.get("budget_s", 0.0)), f.get("label"), message)
+    if name == "DeviceUnresponsiveError":
+        return DeviceUnresponsiveError(
+            float(f.get("budget_s", 0.0)), f.get("device", "default"), message)
+    if name == "NotPositiveDefiniteError":
+        return NotPositiveDefiniteError(int(f.get("info", 0)), message)
+    if name == "NonFiniteError":
+        return NonFiniteError(f.get("stage", "?"), message)
+    if name == "WireProtocolError":
+        return WireProtocolError(f.get("reason", "?"), message)
+    if name == "ConvergenceError":
+        return ConvergenceError(message)
+    if name == "DistributionError":
+        return DistributionError(message)
+    if name == "ConfigurationError":
+        return ConfigurationError(message)
+    return RemoteWorkerError(name, message)
+
+
+# ------------------------------------------------- request checkpoint (HDF5)
+
+
+def save_request_checkpoint(path: str, entries: list) -> str:
+    """Persist drained requests for the failover handshake.  Each entry is
+    a dict: ``id`` / ``kind`` / ``uplo`` / ``squeeze`` / ``deadline_rem_s``
+    (None = unbounded) / ``age_s`` (queue time already spent) / ``a`` /
+    ``b`` (optional RHS).  Atomic tmp+rename like
+    ``resilience.save_checkpoint``; returns ``path``."""
+    import os
+
+    import h5py
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with h5py.File(tmp, "w") as f:
+        f.attrs["schema"] = REQ_CKPT_SCHEMA
+        f.attrs["count"] = len(entries)
+        for i, e in enumerate(entries):
+            g = f.create_group(f"req{i:06d}")
+            g.attrs["id"] = str(e["id"])
+            g.attrs["kind"] = str(e["kind"])
+            g.attrs["uplo"] = str(e["uplo"])
+            g.attrs["squeeze"] = bool(e.get("squeeze", False))
+            rem = e.get("deadline_rem_s")
+            g.attrs["deadline_rem_s"] = float("nan") if rem is None else float(rem)
+            g.attrs["age_s"] = float(e.get("age_s", 0.0))
+            g.create_dataset("a", data=np.asarray(e["a"]))
+            if e.get("b") is not None:
+                g.create_dataset("b", data=np.asarray(e["b"]))
+    os.replace(tmp, path)
+    from dlaf_tpu import health
+
+    health.record("request_checkpoint_written", path=path, count=len(entries))
+    return path
+
+
+def load_request_checkpoint(path: str) -> list:
+    """Read a request checkpoint back into entry dicts (see
+    :func:`save_request_checkpoint`); schema mismatches raise
+    :class:`WireProtocolError` (``header``)."""
+    import math
+
+    import h5py
+
+    try:
+        with h5py.File(path, "r") as f:
+            schema = f.attrs.get("schema")
+            if schema != REQ_CKPT_SCHEMA:
+                raise WireProtocolError(
+                    "header", f"{path}: checkpoint schema {schema!r} != "
+                              f"{REQ_CKPT_SCHEMA!r}")
+            entries = []
+            for name in sorted(f):
+                g = f[name]
+                rem = float(g.attrs["deadline_rem_s"])
+                entries.append({
+                    "id": str(g.attrs["id"]),
+                    "kind": str(g.attrs["kind"]),
+                    "uplo": str(g.attrs["uplo"]),
+                    "squeeze": bool(g.attrs["squeeze"]),
+                    "deadline_rem_s": None if math.isnan(rem) else rem,
+                    "age_s": float(g.attrs["age_s"]),
+                    "a": np.asarray(g["a"]),
+                    "b": np.asarray(g["b"]) if "b" in g else None,
+                })
+    except OSError as exc:
+        raise WireProtocolError(
+            "header", f"{path}: not a readable request checkpoint: {exc}"
+        ) from exc
+    from dlaf_tpu import health
+
+    health.record("request_checkpoint_restored", path=path, count=len(entries))
+    return entries
+
+
+# ------------------------------------------------------------- gateway edge
+
+
+class GatewayServer:
+    """``Gateway.submit`` behind a real wire: an asyncio frame server on
+    localhost TCP (``host``/``port``) or a unix socket (``uds``).
+
+    Protocol (client -> server): ``{"op": "submit", "id", "tenant",
+    "kind", "uplo", "deadline_s"}`` + arrays ``a`` (and ``b`` for posv);
+    ``{"op": "ping"}``.  Server -> client: ``{"op": "result", "id",
+    "kind", "info", "queue_s"}`` + arrays ``x`` or ``w``/``v``;
+    ``{"op": "error", "id", "error", "message", "fields"}`` (typed via
+    :func:`rebuild_error` client-side); ``{"op": "pong"}``.  Requests are
+    streamed: every submit spawns a task, so one connection holds many in
+    flight and responses interleave in completion order.  A malformed
+    frame gets a best-effort ``error`` frame, then the connection closes
+    (framing is unrecoverable once the stream desyncs)."""
+
+    def __init__(self, gateway, *, host: str = "127.0.0.1", port: int = 0,
+                 uds: str | None = None, max_bytes: int | None = None):
+        self.gateway = gateway
+        self.host = host
+        self.port = port
+        self.uds = uds
+        self.max_bytes = max_bytes
+        self.address = None
+        self._server = None
+        self._conn_tasks: set = set()
+
+    async def start(self):
+        if self.uds:
+            self._server = await asyncio.start_unix_server(
+                self._handle, path=self.uds)
+            self.address = self.uds
+        else:
+            self._server = await asyncio.start_server(
+                self._handle, host=self.host, port=self.port)
+            self.address = self._server.sockets[0].getsockname()[:2]
+        return self
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    async def _handle(self, reader, writer) -> None:
+        # frame writes must serialize per connection: two interleaved
+        # responses would corrupt the stream for every later frame
+        wlock = asyncio.Lock()
+
+        async def reply(msg, arrays=None):
+            async with wlock:
+                # dlaf: ignore[DLAF004] per-connection frame writes must
+                # serialize; drain() is asyncio backpressure, not a queue drain
+                await awrite_frame(writer, msg, arrays, max_bytes=self.max_bytes)
+
+        async def one(msg, arrays):
+            rid = msg.get("id")
+            try:
+                res = await self.gateway.submit(
+                    msg["tenant"], msg["kind"], msg.get("uplo", "L"),
+                    arrays["a"], arrays.get("b"),
+                    deadline_s=msg.get("deadline_s"))
+            except Exception as exc:  # noqa: BLE001 - typed over the wire
+                await reply({"op": "error", "id": rid, **error_fields(exc)})
+                return
+            out = {}
+            if res.x is not None:
+                out["x"] = res.x
+            if res.w is not None:
+                out["w"] = res.w
+            if res.v is not None:
+                out["v"] = res.v
+            await reply({"op": "result", "id": rid, "kind": res.kind,
+                         "info": res.info, "queue_s": res.queue_s}, out)
+
+        try:
+            while True:
+                try:
+                    frame = await aread_frame(reader, max_bytes=self.max_bytes)
+                except WireProtocolError as exc:
+                    try:
+                        await reply({"op": "error", "id": None,
+                                     **error_fields(exc)})
+                    except Exception:  # noqa: BLE001 - peer may be gone
+                        pass
+                    return
+                if frame is None:
+                    return
+                msg, arrays = frame
+                op = msg.get("op")
+                if op == "submit":
+                    t = asyncio.ensure_future(one(msg, arrays))
+                    self._conn_tasks.add(t)
+                    t.add_done_callback(self._conn_tasks.discard)
+                elif op == "ping":
+                    await reply({"op": "pong"})
+                else:
+                    await reply({"op": "error", "id": msg.get("id"),
+                                 **error_fields(WireProtocolError(
+                                     "header", f"unknown op {op!r}"))})
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001 - peer reset during close
+                pass
+
+
+class GatewayClient:
+    """Async client for :class:`GatewayServer`: ``submit`` mirrors
+    ``Gateway.submit`` (returns a rebuilt
+    :class:`~dlaf_tpu.serve.pool.ServeResult`, raises rebuilt taxonomy
+    errors) with any number of requests streaming on one connection."""
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
+                 uds: str | None = None, max_bytes: int | None = None):
+        self.host = host
+        self.port = port
+        self.uds = uds
+        self.max_bytes = max_bytes
+        self._reader = None
+        self._writer = None
+        self._wlock = asyncio.Lock()
+        self._pending: dict = {}
+        self._seq = 0
+        self._reader_task = None
+
+    async def connect(self):
+        if self.uds:
+            self._reader, self._writer = await asyncio.open_unix_connection(
+                self.uds)
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:  # noqa: BLE001 - server already gone
+                pass
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = await aread_frame(self._reader, max_bytes=self.max_bytes)
+                if frame is None:
+                    break
+                msg, arrays = frame
+                fut = self._pending.pop(msg.get("id"), None)
+                if msg.get("op") == "result" and fut is not None:
+                    from dlaf_tpu.serve.pool import ServeResult
+
+                    fut.set_result(ServeResult(
+                        kind=msg["kind"], info=int(msg["info"]),
+                        queue_s=float(msg["queue_s"]), x=arrays.get("x"),
+                        w=arrays.get("w"), v=arrays.get("v")))
+                elif msg.get("op") == "error" and fut is not None:
+                    fut.set_exception(rebuild_error(
+                        msg.get("error", "?"), msg.get("message", ""),
+                        msg.get("fields")))
+        except (WireProtocolError, OSError, asyncio.CancelledError) as exc:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(WireProtocolError(
+                        "truncated", f"gateway connection lost: {exc}"))
+            self._pending.clear()
+
+    async def submit(self, tenant: str, kind: str, uplo: str, a, b=None, *,
+                     deadline_s: float | None = None):
+        self._seq += 1
+        rid = self._seq
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        arrays = {"a": np.asarray(a)}
+        if b is not None:
+            arrays["b"] = np.asarray(b)
+        async with self._wlock:
+            # dlaf: ignore[DLAF004] per-connection frame writes must
+            # serialize; drain() is asyncio backpressure, not a queue drain
+            await awrite_frame(
+                self._writer,
+                {"op": "submit", "id": rid, "tenant": tenant, "kind": kind,
+                 "uplo": uplo, "deadline_s": deadline_s},
+                arrays, max_bytes=self.max_bytes)
+        return await fut
+
+    async def ping(self) -> None:
+        async with self._wlock:
+            # dlaf: ignore[DLAF004] see submit: serialized frame writes
+            await awrite_frame(self._writer, {"op": "ping"},
+                               max_bytes=self.max_bytes)

@@ -1,0 +1,311 @@
+"""Fleet replica worker: one OS process, one PJRT client, one SolverPool.
+
+``python -m dlaf_tpu.serve.worker --host H --port P --name replica0``
+(or, as the supervisor does it, ``multiprocessing`` spawn of
+:func:`run_worker`) connects back to the supervisor's control socket and
+runs a thin frame loop: ``submit`` frames become pool requests whose
+results stream back as ``result``/``error`` frames, ``heartbeat`` frames
+answer liveness (optionally running a real
+``resilience.DeviceWatchdog`` probe on this process's own device mesh —
+watchdog semantics over the wire), ``drain`` checkpoints the
+queued-but-undispatched requests to HDF5 for the supervisor's failover
+handshake, and ``shutdown`` exits cleanly.
+
+Cold start is seconds, not ``serve_compile_grace_s``: the worker runs
+``plan.warmup`` over the serve bucket ladder at spawn, under whatever
+``DLAF_TPU_COMPILE_CACHE`` the supervisor routed into its environment —
+so a respawned replica AOT-loads every executable (0 jit compiles) and
+its ``ready`` frame carries the compile/AOT-load attribution for the
+parent's ``replica_warmup`` event.
+
+Postmortems: the flight recorder is always on in a worker; a crash or
+SIGTERM dumps ``flight_*.json`` into ``--flight-dir`` before exit, and
+the supervisor collects those files into the parent's flight dir stamped
+with the worker id — a killed replica leaves evidence, not silence.
+
+``--fake {exit,crash,hang,serve}`` replaces the real pool with scripted
+behaviour (immediate exit, crash-with-dump, ignore-everything hang,
+heartbeat-only serving) so supervisor restart/backoff/circuit tests run
+without paying pool warmup per spawn.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+from dlaf_tpu.obs import flight
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.serve import wire
+
+_WARM_ZERO = {"plans": 0, "compiles": 0, "aot_loads": 0, "seconds": 0.0}
+
+
+class _Conn:
+    """The worker's half of the control channel: one blocking socket,
+    writes serialized (pool done-callbacks and the recv loop both send)."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: dict, arrays: dict | None = None) -> None:
+        with self._send_lock:
+            # dlaf: ignore[DLAF004] frame writes must serialize on the one
+            # control socket; sendall is the transport, not a queue wait
+            wire.send_frame(self.sock, msg, arrays)
+
+    def recv(self):
+        return wire.recv_frame(self.sock)
+
+
+def _install_sigterm(name: str):
+    def _on_sigterm(signum, frame):
+        try:
+            flight.dump(f"worker_sigterm:{name}")
+        except Exception:  # noqa: BLE001 - exiting anyway
+            pass
+        os._exit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # non-main thread / unsupported platform
+        pass
+
+
+def _run_fake(conn: _Conn, name: str, mode: str) -> None:
+    """Scripted worker behaviours for supervisor tests (no pool, no jax
+    device work — the spawn still pays the package import, nothing else)."""
+    conn.send({"op": "ready", "name": name, "pid": os.getpid(),
+               "fake": mode, "warm": dict(_WARM_ZERO)})
+    if mode == "exit":
+        sys.exit(3)
+    if mode == "crash":
+        flight.dump(f"worker_crash:fake:{name}")
+        sys.exit(3)
+    if mode == "hang":  # alive but mute: the hung-worker restart path
+        while True:
+            time.sleep(3600)
+    # mode == "serve": heartbeats only
+    while True:
+        frame = conn.recv()
+        if frame is None:
+            return
+        msg, _ = frame
+        op = msg.get("op")
+        if op == "heartbeat":
+            conn.send({"op": "heartbeat_ack", "seq": msg.get("seq"),
+                       "ok": True, "pending": 0, "probe_s": 0.0})
+        elif op == "drain":
+            wire.save_request_checkpoint(msg["ckpt"], [])
+            conn.send({"op": "drained", "count": 0, "ids": [],
+                       "ckpt": msg["ckpt"]})
+        elif op == "shutdown":
+            conn.send({"op": "bye"})
+            return
+        else:
+            conn.send({"op": "error", "id": msg.get("id"),
+                       **wire.error_fields(wire.WireProtocolError(
+                           "header", f"fake worker: unsupported op {op!r}"))})
+
+
+def run_worker(host: str, port: int, name: str, *, buckets: str | None = None,
+               block_size: int | None = None, max_batch: int | None = None,
+               warm_ops=("potrf", "posv", "eigh"), nrhs: int = 1,
+               probe_budget_s: float = 5.0, metrics_out: str | None = None,
+               flight_dir: str | None = None, fake: str | None = None) -> None:
+    """The worker main loop (see module docstring).  Environment is the
+    spawn contract: the supervisor routes ``JAX_PLATFORMS`` / ``XLA_FLAGS``
+    (device count) / ``DLAF_TPU_COMPILE_CACHE`` through the child env
+    before this runs."""
+    if flight_dir:
+        os.makedirs(flight_dir, exist_ok=True)
+    flight.enable(dump_dir=flight_dir)
+    if metrics_out:
+        om.enable(metrics_out)
+    _install_sigterm(name)
+    sock = socket.create_connection((host, int(port)), timeout=60.0)
+    sock.settimeout(None)
+    conn = _Conn(sock)
+    conn.send({"op": "hello", "name": name, "pid": os.getpid()})
+    try:
+        if fake:
+            _run_fake(conn, name, fake)
+            return
+        _run_real(conn, name, buckets=buckets, block_size=block_size,
+                  max_batch=max_batch, warm_ops=warm_ops, nrhs=nrhs,
+                  probe_budget_s=probe_budget_s)
+    except SystemExit:
+        raise
+    except BaseException as exc:  # noqa: BLE001 - postmortem then re-raise
+        flight.dump(f"worker_crash:{type(exc).__name__}")
+        raise
+    finally:
+        om.close()
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _run_real(conn: _Conn, name: str, *, buckets, block_size, max_batch,
+              warm_ops, nrhs, probe_budget_s) -> None:
+    from dlaf_tpu import resilience, tune
+    from dlaf_tpu.plan import core as plan_core
+    from dlaf_tpu.serve import pool as spool
+
+    overrides = {}
+    if buckets:
+        overrides["serve_buckets"] = buckets
+    tune.initialize(**overrides)
+    pool = spool.SolverPool(block_size=block_size, max_batch=max_batch)
+    warm = plan_core.warmup(ops=tuple(warm_ops), nrhs=int(nrhs),
+                            cache=pool.cache)
+    om.emit("serve", event="replica_warmup", replica=name,
+            plans=warm["plans"], compiles=warm["compiles"],
+            aot_loads=warm["aot_loads"], seconds=warm["seconds"])
+    watchdog = resilience.DeviceWatchdog(budget_s=float(probe_budget_s))
+    import jax
+
+    conn.send({"op": "ready", "name": name, "pid": os.getpid(),
+               "devices": jax.local_device_count(),
+               "compile_cache": tune.compile_cache_dir(),
+               "warm": {k: warm[k] for k in _WARM_ZERO}})
+
+    inflight: dict = {}  # wire id -> _Request (undispatched OR dispatched)
+    inflight_lock = threading.Lock()
+
+    def _done_cb(rid):
+        def cb(fut):
+            with inflight_lock:
+                if inflight.pop(rid, None) is None:
+                    return  # drained to a checkpoint: the supervisor owns it
+            try:
+                if fut.cancelled():
+                    conn.send({"op": "error", "id": rid,
+                               **wire.error_fields(wire.DistributionError(
+                                   "serve: pool closed under this request"))})
+                elif fut.exception() is not None:
+                    conn.send({"op": "error", "id": rid,
+                               **wire.error_fields(fut.exception())})
+                else:
+                    res = fut.result()
+                    arrays = {k: v for k, v in
+                              (("x", res.x), ("w", res.w), ("v", res.v))
+                              if v is not None}
+                    conn.send({"op": "result", "id": rid, "kind": res.kind,
+                               "info": res.info, "queue_s": res.queue_s},
+                              arrays)
+            except OSError:
+                pass  # supervisor gone; the recv loop will see EOF and exit
+        return cb
+
+    while True:
+        frame = conn.recv()
+        if frame is None:
+            pool.close()
+            return
+        msg, arrays = frame
+        op = msg.get("op")
+        if op == "submit":
+            rid = msg.get("id")
+            try:
+                req = spool.make_request(
+                    msg["kind"], msg.get("uplo", "L"), arrays["a"],
+                    arrays.get("b"), deadline_s=msg.get("deadline_rem_s"))
+            except Exception as exc:  # noqa: BLE001 - typed back over the wire
+                conn.send({"op": "error", "id": rid, **wire.error_fields(exc)})
+                continue
+            req._wire_id = rid
+            req.squeeze = bool(msg.get("squeeze", req.squeeze))
+            # keep queue-latency accounting cumulative across the hop: time
+            # already spent queued parent-side is queue time, not service
+            req.t_submit -= float(msg.get("age_s", 0.0))
+            with inflight_lock:
+                inflight[rid] = req
+            req.future.add_done_callback(_done_cb(rid))
+            overflow = pool.adopt([req])
+            if overflow:
+                with inflight_lock:
+                    inflight.pop(rid, None)
+                conn.send({"op": "error", "id": rid,
+                           **wire.error_fields(wire.QueueFullError(
+                               pool.pending(), pool.max_queue))})
+        elif op == "heartbeat":
+            ok, probe_s = True, 0.0
+            if msg.get("probe"):
+                try:
+                    probe_s = watchdog.probe(msg.get("budget_s"))
+                except Exception:  # noqa: BLE001 - the probe verdict
+                    ok = False
+            conn.send({"op": "heartbeat_ack", "seq": msg.get("seq"), "ok": ok,
+                       "pending": pool.pending(), "probe_s": float(probe_s)})
+        elif op == "drain":
+            reqs = pool.drain()
+            entries = []
+            now = time.monotonic()
+            with inflight_lock:
+                for r in reqs:
+                    rid = getattr(r, "_wire_id", None)
+                    rid = rid if rid is not None else _rid_of(inflight, r)
+                    if rid is None:
+                        continue
+                    inflight.pop(rid, None)
+                    entries.append({
+                        "id": rid, "kind": r.kind, "uplo": r.uplo,
+                        "squeeze": r.squeeze,
+                        "deadline_rem_s": r.remaining(),
+                        "age_s": now - r.t_submit, "a": r.a, "b": r.b,
+                    })
+            wire.save_request_checkpoint(msg["ckpt"], entries)
+            conn.send({"op": "drained", "count": len(entries),
+                       "ids": [e["id"] for e in entries],
+                       "ckpt": msg["ckpt"]})
+        elif op == "shutdown":
+            pool.close()
+            conn.send({"op": "bye"})
+            return
+        else:
+            conn.send({"op": "error", "id": msg.get("id"),
+                       **wire.error_fields(wire.WireProtocolError(
+                           "header", f"worker: unknown op {op!r}"))})
+
+
+def _rid_of(inflight: dict, req) -> str | None:
+    for rid, r in inflight.items():
+        if r is req:
+            return rid
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--buckets", default=None)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--warm-ops", default="potrf,posv,eigh")
+    ap.add_argument("--nrhs", type=int, default=1)
+    ap.add_argument("--probe-budget-s", type=float, default=5.0)
+    ap.add_argument("--metrics-out", default=None)
+    ap.add_argument("--flight-dir", default=None)
+    ap.add_argument("--fake", default=None,
+                    choices=("exit", "crash", "hang", "serve"))
+    args = ap.parse_args(argv)
+    run_worker(args.host, args.port, args.name, buckets=args.buckets,
+               block_size=args.block_size, max_batch=args.max_batch,
+               warm_ops=tuple(args.warm_ops.split(",")), nrhs=args.nrhs,
+               probe_budget_s=args.probe_budget_s,
+               metrics_out=args.metrics_out, flight_dir=args.flight_dir,
+               fake=args.fake)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

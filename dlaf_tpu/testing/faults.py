@@ -16,6 +16,11 @@ cannot ride an operand — they are injected through the documented
 path and the driver panel boundaries), which the production detectors
 (deadlines, watchdog, checkpoint restore) always traverse.  Each is a
 context manager restoring the previous injection state on exit.
+
+PROCESS faults (:func:`process_kill`, :func:`network_partition`) target a
+:class:`~dlaf_tpu.serve.fleet.Fleet`: the first delivers a real signal to
+a real worker OS process, the second blocks the parent→worker wire — the
+two failure modes the supervisor's restart/failover machinery exists for.
 """
 from __future__ import annotations
 
@@ -151,6 +156,47 @@ def replica_down(router, name: str, seconds: float | None = None):
             wd.probe = shadow
         else:
             del wd.__dict__["probe"]
+
+
+def process_kill(fleet, name: str, sig: int | None = None) -> None:
+    """Kill fleet worker ``name``'s real OS process (SIGKILL by default —
+    the unceremonious death a preemption or OOM delivers).  Nothing is
+    patched: the supervisor's monitor notices the dead process through the
+    production path (heartbeat/`is_alive`), collects the child's flight
+    dumps, re-dispatches its outstanding queue to siblings, and respawns
+    under the backoff policy.  The process-level counterpart of
+    :func:`replica_down` for :class:`~dlaf_tpu.serve.fleet.Fleet` runs."""
+    import signal as _signal
+
+    fleet.kill_worker(name, _signal.SIGKILL if sig is None else sig)
+
+
+@contextmanager
+def network_partition(fleet, name: str, seconds: float | None = None):
+    """Partition fleet worker ``name`` from the supervisor: parent→worker
+    frames (submits, heartbeats, drains) fail as if the link dropped,
+    while results the worker already computed are still processed when
+    they arrive — an asymmetric one-way partition, the nastier real-world
+    case.  The worker process itself keeps running.
+
+    With ``seconds=None`` the partition lasts the whole ``with`` block;
+    with a number it heals on its own after ``seconds`` (a transient
+    blip — short ones heal before ``serve_fleet_hang_restart_s`` and cost
+    only a failover sweep; long ones get the worker restarted as hung)."""
+    import threading
+
+    fleet.partition_worker(name)
+    timer = None
+    if seconds is not None:
+        timer = threading.Timer(float(seconds), fleet.heal_worker, args=(name,))
+        timer.daemon = True
+        timer.start()
+    try:
+        yield fleet.handle(name)
+    finally:
+        if timer is not None:
+            timer.cancel()
+        fleet.heal_worker(name)
 
 
 @contextmanager

@@ -212,6 +212,38 @@ class TuneParameters:
       batches) the gateway sheds: expired requests are evicted first,
       then the lowest-priority queued request if the newcomer outranks
       it, else the newcomer is rejected with ``QueueFullError``.
+    - ``serve_fleet_heartbeat_s``: period of the fleet supervisor's
+      heartbeat/probe sweep over its worker processes
+      (``serve.supervisor``).  Each sweep sends one heartbeat frame per
+      worker (watchdog-probe semantics over the wire) and pumps the
+      gateway's failover check.
+    - ``serve_fleet_backoff_base_s`` / ``serve_fleet_backoff_cap_s``:
+      exponential restart backoff for crashed/hung workers — the k-th
+      consecutive failure waits ``min(cap, base * 2**k)`` seconds before
+      the respawn.
+    - ``serve_fleet_crash_loop``: consecutive-failure count that opens the
+      crash-loop circuit breaker; the supervisor stops restarting that
+      worker (emitting a ``fleet`` ``circuit_open`` event) until a human
+      (or a scale-up) intervenes.  A worker that stays ready longer than
+      the backoff cap resets its failure streak.
+    - ``serve_fleet_hang_restart_s``: how long a worker may fail probes
+      while its process is still alive before the supervisor declares it
+      hung and kills/restarts it — longer than any expected network
+      partition (the ``network_partition`` fault heals within this
+      window; a truly wedged PJRT client does not).
+    - ``serve_fleet_scale_up_p95_s`` / ``serve_fleet_scale_up_queue``:
+      autoscaler scale-UP triggers — sustained worst-tenant p95 above the
+      former, or gateway queue depth above the latter, spawns a worker.
+    - ``serve_fleet_scale_down_queue``: sustained queue depth below this
+      (with p95 also healthy) retires the emptiest worker.
+    - ``serve_fleet_scale_up_cooldown_s`` /
+      ``serve_fleet_scale_down_cooldown_s``: minimum spacing after any
+      scale action before the next up/down decision — the hysteresis that
+      bounds oscillation (down-cooldown is the longer one so a burst's
+      trailing edge does not flap spawn/retire).
+    - ``serve_fleet_max_frame_mb``: wire-frame size bound for the fleet
+      transports (``serve.wire``) — a forged length prefix must not make
+      a reader allocate gigabytes.
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -272,6 +304,39 @@ class TuneParameters:
     serve_gateway_max_queue: int = field(
         default_factory=lambda: _env("serve_gateway_max_queue", 2048, int)
     )
+    serve_fleet_heartbeat_s: float = field(
+        default_factory=lambda: _env("serve_fleet_heartbeat_s", 1.0, float)
+    )
+    serve_fleet_backoff_base_s: float = field(
+        default_factory=lambda: _env("serve_fleet_backoff_base_s", 0.5, float)
+    )
+    serve_fleet_backoff_cap_s: float = field(
+        default_factory=lambda: _env("serve_fleet_backoff_cap_s", 10.0, float)
+    )
+    serve_fleet_crash_loop: int = field(
+        default_factory=lambda: _env("serve_fleet_crash_loop", 5, int)
+    )
+    serve_fleet_hang_restart_s: float = field(
+        default_factory=lambda: _env("serve_fleet_hang_restart_s", 10.0, float)
+    )
+    serve_fleet_scale_up_p95_s: float = field(
+        default_factory=lambda: _env("serve_fleet_scale_up_p95_s", 2.0, float)
+    )
+    serve_fleet_scale_up_queue: int = field(
+        default_factory=lambda: _env("serve_fleet_scale_up_queue", 32, int)
+    )
+    serve_fleet_scale_down_queue: int = field(
+        default_factory=lambda: _env("serve_fleet_scale_down_queue", 2, int)
+    )
+    serve_fleet_scale_up_cooldown_s: float = field(
+        default_factory=lambda: _env("serve_fleet_scale_up_cooldown_s", 10.0, float)
+    )
+    serve_fleet_scale_down_cooldown_s: float = field(
+        default_factory=lambda: _env("serve_fleet_scale_down_cooldown_s", 30.0, float)
+    )
+    serve_fleet_max_frame_mb: float = field(
+        default_factory=lambda: _env("serve_fleet_max_frame_mb", 64.0, float)
+    )
     panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
     dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(
@@ -293,6 +358,8 @@ class TuneParameters:
                 validate_gemm_precision(v)
             elif k in ("blas3_matmul_precision", "eigensolver_matmul_precision"):
                 validate_matmul_precision(v, knob=k)
+            elif k.startswith("serve_fleet_"):
+                validate_serve_fleet_knob(k, v)
             setattr(self, k, v)
         return self
 
@@ -388,6 +455,36 @@ def resolved_gemm_precision() -> str:
 #: modeled-flops multiplier obs/bench attribute the split tiers' extra work
 #: with (report_metrics.py precision roll-up).
 GEMM_TIER_FLOP_MULTIPLIER = {"default": 1, "auto": 1, "bf16x3": 3, "bf16x6": 6}
+
+
+def validate_serve_fleet_knob(knob: str, value) -> None:
+    """Fail-fast domain check for the ``serve_fleet_*`` knobs: every one is
+    a positive number (``serve_fleet_scale_down_queue`` may be 0 — "only
+    scale down when idle"); ``serve_fleet_crash_loop`` must be an integer
+    >= 1 (a 0 threshold would open the circuit before the first spawn).
+    Same shape as :func:`validate_collectives_impl`: checked on explicit
+    ``update(...)`` AND when the supervisor/autoscaler read the knobs, so
+    a typo'd ``DLAF_TPU_SERVE_FLEET_*`` env value surfaces as a
+    ConfigurationError, not a stuck fleet."""
+    from dlaf_tpu.health import ConfigurationError
+
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        raise ConfigurationError(
+            f"{knob} must be numeric, got {value!r} "
+            f"(env DLAF_TPU_{knob.upper()})") from None
+    floor = 0.0 if knob == "serve_fleet_scale_down_queue" else None
+    if floor is not None:
+        ok = v >= floor
+    elif knob == "serve_fleet_crash_loop":
+        ok = v >= 1 and float(v).is_integer()
+    else:
+        ok = v > 0
+    if not ok:
+        raise ConfigurationError(
+            f"{knob} must be {'an integer >= 1' if knob == 'serve_fleet_crash_loop' else '> 0'}, "
+            f"got {value!r} (env DLAF_TPU_{knob.upper()})")
 
 
 def validate_collectives_impl(value) -> str:

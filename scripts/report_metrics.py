@@ -387,6 +387,65 @@ def summarize(path: str) -> int:
                 print("   failover: "
                       + "  ".join(f"{e}={n}" for e, n in sorted(fo.items())))
 
+    fleet = by_kind.get("fleet", [])
+    if fleet:
+        counts = defaultdict(int)
+        for r in fleet:
+            counts[r["event"]] += 1
+        print(f"-- fleet ({len(fleet)} events):")
+        life = "  ".join(f"{e}={counts[e]}" for e in
+                         ("worker_spawn", "worker_ready", "worker_exit",
+                          "worker_restart", "circuit_open")
+                         if counts.get(e))
+        if life:
+            print(f"   lifecycle: {life}")
+        # per-worker roll-up (worker_stats is emitted once per handle at
+        # fleet close; generation > 1 means the supervisor restarted it)
+        wstats = [r for r in fleet if r["event"] == "worker_stats"]
+        if wstats:
+            print(f"   {'worker':>10s} {'gen':>4s} {'served':>7s} "
+                  f"{'failures':>9s} {'circuit':>8s}")
+            for r in sorted(wstats, key=lambda r: str(r.get("worker", "?"))):
+                print(f"   {r.get('worker', '?'):>10s} {r.get('gen', 0):4d} "
+                      f"{r.get('served', 0):7d} {r.get('failures', 0):9d} "
+                      f"{'OPEN' if r.get('circuit_open') else 'closed':>8s}")
+        # warmup attribution: the zero-compile restart contract in one line
+        readies = [r for r in fleet if r["event"] == "worker_ready"]
+        if readies:
+            wc = sum(int(r.get("warm_compiles", 0)) for r in readies)
+            wa = sum(int(r.get("warm_aot_loads", 0)) for r in readies)
+            zero = sum(1 for r in readies if not int(r.get("warm_compiles", 0)))
+            print(f"   warmups: {len(readies)} worker readies — "
+                  f"{wc} compiles, {wa} AOT loads "
+                  f"({zero} zero-compile starts)")
+        drains = [r for r in fleet if r["event"] == "failover_drain"]
+        if drains:
+            by_mode = defaultdict(lambda: [0, 0])
+            for r in drains:
+                bm = by_mode[r.get("mode", "?")]
+                bm[0] += 1
+                bm[1] += int(r.get("count", 0))
+            print("   failover drains: " + "  ".join(
+                f"{m}={n} ({c} requests)" for m, (n, c)
+                in sorted(by_mode.items())))
+        if counts.get("partition") or counts.get("partition_heal"):
+            print(f"   partitions: {counts.get('partition', 0)} injected, "
+                  f"{counts.get('partition_heal', 0)} healed")
+        if counts.get("flight_collected"):
+            print(f"   child flight dumps collected: "
+                  f"{counts['flight_collected']}")
+        scales = [r for r in fleet
+                  if r["event"] in ("scale_up", "scale_down",
+                                    "scale_up_joined", "scale_up_failed",
+                                    "scale_down_retired")]
+        if scales:
+            print(f"   autoscale decisions ({len(scales)}):")
+            for r in scales:
+                sig = "  ".join(f"{k}={r[k]}" for k in
+                                ("p95_s", "queued", "workers", "worker",
+                                 "shed") if k in r)
+                print(f"      {r['event']:20s} {sig}")
+
     plan = by_kind.get("plan", [])
     if plan:
         counts = defaultdict(int)

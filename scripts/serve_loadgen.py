@@ -23,6 +23,13 @@ decides pass/fail — ``python -m dlaf_tpu.scenario list`` shows the
 library.  The loadgen core lives in ``dlaf_tpu/scenario/runner.py``;
 this script only parses arguments and forces the CPU mesh.
 
+``--fleet`` (scenario mode) serves through real worker OS processes
+(serve v3): ``--workers`` processes supervised with restart backoff,
+checkpoint-carried failover, and real process-level fault injection
+(``replica_down`` escalates to SIGKILL).  ``--autoscale`` additionally
+turns on SLO-driven elasticity between ``--min-workers`` and
+``--max-workers`` and gates the run on the autoscaler's behaviour.
+
 Exit is nonzero if any check fails.
 """
 from __future__ import annotations
@@ -65,6 +72,18 @@ def main(argv=None) -> int:
     ap.add_argument("--time-scale", type=float, default=1.0,
                     help="(scenario mode) compress (<1) or stretch (>1) the "
                          "arrival + fault timeline")
+    ap.add_argument("--fleet", action="store_true",
+                    help="(scenario mode) serve through a cross-process "
+                         "worker fleet (serve v3) instead of in-process "
+                         "replica pools")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="(fleet mode) worker process count "
+                         "(default: the scenario's replica count)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="(fleet mode) enable SLO-driven elastic "
+                         "autoscaling and gate on its behaviour")
+    ap.add_argument("--min-workers", type=int, default=1)
+    ap.add_argument("--max-workers", type=int, default=4)
     args = ap.parse_args(argv)
 
     from dlaf_tpu import scenario
@@ -74,8 +93,14 @@ def main(argv=None) -> int:
         result = runner.run_scenario(
             scenario.get(args.scenario), requests=args.requests,
             out=args.out, trace_out=args.trace_out,
-            time_scale=args.time_scale)
+            time_scale=args.time_scale, fleet=args.fleet,
+            workers=args.workers, autoscale=args.autoscale,
+            min_workers=args.min_workers, max_workers=args.max_workers)
         return 0 if result.passed else 1
+
+    if args.fleet or args.autoscale:
+        ap.error("--fleet/--autoscale require --scenario (the open-loop "
+                 "runner owns the fleet lifecycle)")
 
     if args.requests is None:
         args.requests = 10_000

@@ -1,0 +1,543 @@
+"""Serve v3 cross-process fleet — wire, supervisor, elasticity (ISSUE 15).
+
+Bottom-up over the fleet stack: the frame codec round-trips array
+payloads and rejects every malformation with a machine-stable typed
+reason (magic / oversize / truncated / header / array — a forged length
+prefix must not make a reader allocate gigabytes); taxonomy errors
+rebuild their real classes across the process boundary; request
+checkpoints carry drained queues through HDF5 bit-for-bit; the
+autoscaler's hysteresis is exercised as a pure decision function on a
+synthetic clock (scale up under sustained load, back down after, no
+flapping under oscillation); the supervisor restarts crashing fake
+workers under exponential backoff and opens the crash-loop circuit
+breaker; and ONE real two-process fleet run proves the acceptance core:
+a SIGKILLed worker mid-stream loses zero admitted requests and its
+replacement warms from the shared compile cache with zero jit compiles.
+"""
+import asyncio
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from dlaf_tpu import serve, tune
+from dlaf_tpu.health import (
+    ConfigurationError,
+    DeadlineExceededError,
+    DeviceUnresponsiveError,
+    NotPositiveDefiniteError,
+    QueueFullError,
+    RemoteWorkerError,
+    TenantQuotaExceededError,
+    WireProtocolError,
+)
+from dlaf_tpu.obs import flight
+from dlaf_tpu.serve import wire
+from dlaf_tpu.serve.supervisor import xla_flags_with_device_count
+from dlaf_tpu.testing import faults, random_hermitian_pd
+
+# ---------------------------------------------------------------- framing
+
+
+def test_frame_round_trips_messages_and_arrays():
+    msg = {"op": "submit", "id": "replica0.g1:7", "kind": "posv",
+           "deadline_rem_s": None}
+    arrays = {"a": random_hermitian_pd(12, np.float64, seed=3),
+              "b": np.arange(24, dtype=np.float32).reshape(12, 2),
+              "empty": np.zeros((0, 4), dtype=np.int32)}
+    out_msg, out = wire.decode_frame(wire.encode_frame(msg, arrays))
+    assert out_msg == msg
+    assert set(out) == set(arrays)
+    for name, arr in arrays.items():
+        assert out[name].dtype == arr.dtype, name
+        assert out[name].shape == arr.shape, name
+        np.testing.assert_array_equal(out[name], arr)
+    # decoded arrays are writable copies, not payload views
+    out["a"][0, 0] = 42.0
+
+
+def test_frame_rejections_are_typed():
+    good = wire.encode_frame({"op": "ping"}, {"a": np.ones(3)})
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(b"HTTP" + good[4:])
+    assert ei.value.reason == "magic"
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(good[:-5])
+    assert ei.value.reason == "truncated"
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(good[:7])
+    assert ei.value.reason == "truncated"
+    with pytest.raises(WireProtocolError) as ei:
+        wire.encode_frame({"op": "big"}, {"a": np.zeros(1 << 14)},
+                          max_bytes=1 << 10)
+    assert ei.value.reason == "oversize"
+    # a forged length prefix is refused BEFORE any allocation
+    forged = bytearray(good)
+    forged[4:12] = (1 << 31).to_bytes(4, "big") + (1 << 31).to_bytes(4, "big")
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(bytes(forged), max_bytes=1 << 20)
+    assert ei.value.reason == "oversize"
+
+
+def test_frame_garbage_header_and_array_are_typed():
+    # valid prefix, header bytes that are not JSON
+    junk = b"\x00\xffnot json"
+    buf = wire.MAGIC + len(junk).to_bytes(4, "big") + (0).to_bytes(4, "big") + junk
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(buf)
+    assert ei.value.reason == "header"
+    # array descriptor pointing outside the payload
+    header = json.dumps({"msg": {}, "arrays": [
+        {"name": "a", "dtype": "<f8", "shape": [64], "offset": 0,
+         "nbytes": 512}]}).encode()
+    buf = (wire.MAGIC + len(header).to_bytes(4, "big")
+           + (16).to_bytes(4, "big") + header + b"\x00" * 16)
+    with pytest.raises(WireProtocolError) as ei:
+        wire.decode_frame(buf)
+    assert ei.value.reason == "array"
+
+
+def test_socket_transport_streams_frames_and_reads_clean_eof():
+    a, b = socket.socketpair()
+    try:
+        for i in range(3):
+            wire.send_frame(a, {"op": "n", "i": i},
+                            {"x": np.full((4,), i, dtype=np.float32)})
+        for i in range(3):
+            msg, arrays = wire.recv_frame(b)
+            assert msg == {"op": "n", "i": i}
+            np.testing.assert_array_equal(
+                arrays["x"], np.full((4,), i, dtype=np.float32))
+        a.close()
+        assert wire.recv_frame(b) is None  # clean EOF between frames
+    finally:
+        b.close()
+
+
+def test_socket_transport_mid_frame_close_is_truncated():
+    a, b = socket.socketpair()
+    try:
+        raw = wire.encode_frame({"op": "n"}, {"x": np.zeros(128)})
+        a.sendall(raw[: len(raw) // 2])
+        a.close()
+        with pytest.raises(WireProtocolError) as ei:
+            wire.recv_frame(b)
+        assert ei.value.reason == "truncated"
+    finally:
+        b.close()
+
+
+# ----------------------------------------------------------- typed errors
+
+
+def test_taxonomy_errors_rebuild_their_real_classes():
+    cases = [
+        QueueFullError(7, 4),
+        TenantQuotaExceededError("bulk", 12.5),
+        DeadlineExceededError(0.25, "potrf"),
+        DeviceUnresponsiveError(1.5, device="replica1"),
+        NotPositiveDefiniteError(3),
+        WireProtocolError("oversize", "too big"),
+    ]
+    for exc in cases:
+        f = wire.error_fields(exc)
+        back = wire.rebuild_error(f["error"], f["message"], f["fields"])
+        assert type(back) is type(exc), exc
+    back = wire.rebuild_error("SomethingNovelError", "boom", {})
+    assert isinstance(back, RemoteWorkerError)
+    assert back.remote_type == "SomethingNovelError"
+
+
+# ------------------------------------------------------ request checkpoint
+
+
+def test_request_checkpoint_round_trips(tmp_path):
+    entries = [
+        {"id": "replica0.g1:5", "kind": "potrf", "uplo": "L",
+         "squeeze": False, "deadline_rem_s": 1.25, "age_s": 0.5,
+         "a": random_hermitian_pd(8, np.float64, seed=1), "b": None},
+        {"id": "replica0.g1:6", "kind": "posv", "uplo": "U",
+         "squeeze": True, "deadline_rem_s": None, "age_s": 0.0,
+         "a": random_hermitian_pd(6, np.float32, seed=2),
+         "b": np.ones((6, 2), dtype=np.float32)},
+    ]
+    path = str(tmp_path / "drain.h5")
+    wire.save_request_checkpoint(path, entries)
+    back = wire.load_request_checkpoint(path)
+    assert [e["id"] for e in back] == [e["id"] for e in entries]
+    for want, got in zip(entries, back):
+        for k in ("kind", "uplo", "squeeze", "deadline_rem_s", "age_s"):
+            assert got[k] == want[k], k
+        np.testing.assert_array_equal(got["a"], want["a"])
+        if want["b"] is None:
+            assert got["b"] is None
+        else:
+            np.testing.assert_array_equal(got["b"], want["b"])
+
+
+def test_request_checkpoint_schema_mismatch_is_typed(tmp_path):
+    import h5py
+
+    path = str(tmp_path / "foreign.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["schema"] = "somebody.else/9"
+    with pytest.raises(WireProtocolError) as ei:
+        wire.load_request_checkpoint(path)
+    assert ei.value.reason == "header"
+    garbage = str(tmp_path / "garbage.h5")
+    with open(garbage, "wb") as f:
+        f.write(b"not hdf5 at all")
+    with pytest.raises(WireProtocolError):
+        wire.load_request_checkpoint(garbage)
+
+
+# ------------------------------------------------------------ spawn plumbing
+
+
+def test_xla_flags_device_count_is_replaced_not_appended():
+    out = xla_flags_with_device_count(
+        "--xla_force_host_platform_device_count=8 --xla_foo=1", 1)
+    assert "--xla_force_host_platform_device_count=1" in out
+    assert "device_count=8" not in out
+    assert "--xla_foo=1" in out
+    out = xla_flags_with_device_count(None, 2)
+    assert out.strip() == "--xla_force_host_platform_device_count=2"
+    assert out.count("device_count") == 1
+
+
+def test_flight_collect_stamps_worker_tag(tmp_path):
+    src = tmp_path / "child"
+    dst = tmp_path / "parent"
+    src.mkdir()
+    dst.mkdir()
+    (src / "flight_1_crash.json").write_text("{}")
+    (src / "flight_2_term.json").write_text("{}")
+    (src / "unrelated.txt").write_text("no")
+    copied = flight.collect(str(src), str(dst), tag="replica0-g2")
+    names = sorted(os.path.basename(p) for p in copied)
+    assert names == ["flight_replica0-g2_1_crash.json",
+                     "flight_replica0-g2_2_term.json"]
+    # idempotent: a second collection does not duplicate
+    assert flight.collect(str(src), str(dst), tag="replica0-g2") == []
+    # a missing source dir is not an error (worker died before dumping)
+    assert flight.collect(str(src / "nope"), str(dst), tag="x") == []
+
+
+# ------------------------------------------------------------- autoscaler
+
+
+def _scripted_autoscaler(signals, **kw):
+    """An Autoscaler over a scripted signal list and a worker counter."""
+    state = {"n": kw.pop("start_workers", 1), "i": 0}
+
+    def signal_fn():
+        i = min(state["i"], len(signals) - 1)
+        state["i"] += 1
+        return signals[i]
+
+    asc = serve.Autoscaler(
+        signal_fn, lambda: state["n"],
+        lambda: state.__setitem__("n", state["n"] + 1),
+        lambda: state.__setitem__("n", state["n"] - 1),
+        sustain=3, up_p95_s=2.0, up_queue=32, down_queue=2,
+        up_cooldown_s=10.0, down_cooldown_s=30.0, **kw)
+    return asc, state
+
+
+def test_autoscaler_scales_up_only_after_sustained_load():
+    asc, state = _scripted_autoscaler([(0.1, 100)] * 10, max_workers=4)
+    assert asc.step(now=0.0) is None
+    assert asc.step(now=1.0) is None
+    assert asc.step(now=2.0) == "scale_up"  # third consecutive hot step
+    assert state["n"] == 2
+    # up-cooldown: sustained load does not fire again inside 10s, and
+    # the first step past the window fires (the streak kept building)
+    assert asc.step(now=3.0) is None
+    assert asc.step(now=4.0) is None
+    assert asc.step(now=5.0) is None
+    assert asc.step(now=11.9) is None
+    assert asc.step(now=12.1) == "scale_up"
+    assert state["n"] == 3
+
+
+def test_autoscaler_scales_down_after_drain_and_cooldown():
+    # hot long enough to scale up once, then fully drained
+    sig = [(0.1, 100)] * 3 + [(5.0, 0)] * 400
+    asc, state = _scripted_autoscaler(sig, max_workers=4)
+    for t in (0.0, 1.0, 2.0):
+        asc.step(now=t)
+    assert state["n"] == 2
+    # stale cumulative p95 stays at 5s — with the queue drained that must
+    # NOT read as hot (the ratchet guard), and scale-down fires once the
+    # 30s down-cooldown from the scale-up has passed
+    for t in (3.0, 4.0, 5.0, 6.0):
+        assert asc.step(now=t) is None  # cold streak builds, cooldown holds
+    assert asc.step(now=33.0) == "scale_down"
+    assert state["n"] == 1
+    # min_workers floor: never drops below
+    for t in (40.0, 80.0, 120.0, 160.0, 200.0):
+        asc.step(now=t)
+    assert state["n"] == 1
+
+
+def test_autoscaler_does_not_flap_under_oscillation():
+    # queue oscillating across the up threshold every step: hysteresis
+    # (sustain=3) must keep the controller silent
+    sig = [(0.1, 100) if i % 2 else (0.1, 0) for i in range(200)]
+    asc, _ = _scripted_autoscaler(sig, max_workers=4)
+    for t in range(200):
+        asc.step(now=float(t))
+    assert [a["action"] for a in asc.actions] == []
+    # slow oscillation (period >> sustain) fires, but cooldowns bound the
+    # rate: same-direction decisions are at least one cooldown apart
+    sig = [(0.1, 100) if (i // 20) % 2 == 0 else (0.1, 0)
+           for i in range(200)]
+    asc, _ = _scripted_autoscaler(sig, max_workers=4)
+    for t in range(200):
+        asc.step(now=float(t))
+    assert asc.actions
+    for kind, cool in (("scale_up", 10.0), ("scale_down", 30.0)):
+        ts = [a["t"] for a in asc.actions if a["action"] == kind]
+        assert all(b - a >= cool for a, b in zip(ts, ts[1:])), (kind, ts)
+    assert all(a["p95_s"] is not None and "queued" in a and "workers" in a
+               for a in asc.actions)
+
+
+def test_autoscaler_respects_max_workers():
+    asc, state = _scripted_autoscaler([(0.1, 100)] * 500, max_workers=3)
+    for t in range(500):
+        asc.step(now=float(t))
+    assert state["n"] == 3
+
+
+# ------------------------------------------------- supervisor (fake workers)
+
+
+def _wait(cond, timeout=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_supervisor_restart_backoff_and_circuit_breaker(tmp_path):
+    sup = serve.Supervisor(
+        base_dir=str(tmp_path), heartbeat_s=60.0, backoff_base_s=0.2,
+        backoff_cap_s=60.0, crash_loop=3, hang_restart_s=60.0)
+    try:
+        h = sup.add_handle(serve.WorkerHandle("w0", fake="crash"))
+        sup.spawn(h)
+        backoffs = []
+        now = time.monotonic()
+        for cycle in range(3):
+            _wait(lambda: h.proc is not None and not h.proc.is_alive(),
+                  what=f"fake worker death (cycle {cycle})")
+            sup.monitor_step(now=now)
+            if h.circuit_open:
+                break
+            assert h.failures == cycle + 1
+            assert h.restart_at is not None
+            backoffs.append(h.restart_at - now)
+            now = h.restart_at + 0.001
+            sup.monitor_step(now=now)  # due: respawns the next generation
+            assert h.restart_at is None
+        # exponential: 0.2, 0.4 (then the circuit opens on failure 3)
+        assert backoffs == pytest.approx([0.2, 0.4])
+        assert h.circuit_open
+        assert h.failures == 3
+        assert h.gen == 3
+        # circuit open: further monitor passes never respawn
+        sup.monitor_step(now=now + 1000.0)
+        assert h.restart_at is None
+        # the crashing fake dumped flight evidence; collection stamped it
+        stamped = [p for p in os.listdir(sup.flight_dir)
+                   if p.startswith("flight_w0-g")]
+        assert stamped, os.listdir(sup.flight_dir)
+    finally:
+        sup.close()
+
+
+def test_supervisor_heartbeats_fake_serve_worker(tmp_path):
+    sup = serve.Supervisor(base_dir=str(tmp_path), heartbeat_s=60.0)
+    try:
+        h = sup.add_handle(serve.WorkerHandle("w0", fake="serve"))
+        sup.spawn(h)
+        sup.wait_ready(h, timeout=60.0)
+        ack = h.heartbeat(probe=True, timeout=10.0)
+        assert ack["ok"] and ack["pending"] == 0
+        wd = serve.WireWatchdog(h, budget_s=5.0)
+        wd.probe()  # alive: no raise
+        h.partitioned = True
+        with pytest.raises(DeviceUnresponsiveError):
+            wd.probe()
+        h.partitioned = False
+        wd.probe()
+    finally:
+        sup.close()
+
+
+# ------------------------------------------------ scenario fault vocabulary
+
+
+def test_fleet_fault_kinds_validate():
+    from dlaf_tpu.scenario import spec
+
+    with pytest.raises(ConfigurationError):
+        spec.FaultEvent(at_s=1.0, kind="process_kill", target=None)
+    with pytest.raises(ConfigurationError):
+        spec.FaultEvent(at_s=1.0, kind="network_partition", target=None)
+    with pytest.raises(ConfigurationError):
+        spec.Scenario("bad", replicas=2, faults=(
+            spec.FaultEvent(at_s=1.0, kind="process_kill",
+                            target="replica9"),))
+    # the fleet scenarios are library citizens and round-trip
+    from dlaf_tpu import scenario as slib
+
+    for name in ("fleet_chaos", "burst_autoscale"):
+        s = slib.get(name)
+        assert spec.Scenario.from_dict(
+            json.loads(json.dumps(s.to_dict()))) == s
+
+
+def test_runner_rejects_mismatched_fault_and_mode():
+    from dlaf_tpu import scenario as slib
+    from dlaf_tpu.scenario import runner
+
+    with pytest.raises(ConfigurationError):
+        runner.run_scenario(slib.get("fleet_chaos"))  # fleet-only faults
+    with pytest.raises(ConfigurationError):
+        runner.run_scenario(slib.get("mesh_hang"), fleet=True)  # hang
+    with pytest.raises(ConfigurationError):
+        runner.run_scenario(slib.get("baseline"), autoscale=True)
+
+
+def test_evaluate_autoscale_gates():
+    from dlaf_tpu.scenario import runner
+
+    up = {"action": "scale_up"}
+    down = {"action": "scale_down"}
+    assert runner.evaluate_autoscale([up, down]) == []
+    assert any("never scaled up" in f
+               for f in runner.evaluate_autoscale([down]))
+    assert any("never scaled back down" in f
+               for f in runner.evaluate_autoscale([up]))
+    assert any("flapping" in f
+               for f in runner.evaluate_autoscale([up, down] * 4))
+
+
+# --------------------------------------------------------- gateway edge
+
+
+def test_gateway_edge_serves_and_types_errors_over_the_wire(tmp_path):
+    tune.initialize(serve_buckets="8")
+    try:
+        pool = serve.SolverPool(block_size=8, max_batch=4)
+        router = serve.Router([serve.Replica("replica0", pool)])
+        gw = serve.Gateway(
+            router, [serve.TenantConfig("t", max_pending=16)],
+            linger_ms=2.0)
+
+        async def main():
+            server = await wire.GatewayServer(gw, port=0).start()
+            host, port = server.address
+            client = await wire.GatewayClient(host=host, port=port).connect()
+            try:
+                a = random_hermitian_pd(6, np.float64, seed=0)
+                res = await client.submit("t", "potrf", "L", a)
+                assert res.kind == "potrf" and res.info == 0
+                np.testing.assert_allclose(
+                    np.tril(res.x) @ np.tril(res.x).T, a, atol=1e-8)
+                # taxonomy errors arrive as their real classes
+                with pytest.raises(ConfigurationError):
+                    await client.submit("nobody", "potrf", "L", a)
+                with pytest.raises(DeadlineExceededError):
+                    await client.submit("t", "potrf", "L", a, deadline_s=0.0)
+                # per-element health: an indefinite member resolves with
+                # its info code, it does not fail the batch
+                bad = np.array(a)
+                bad[0, 0] = -100.0
+                res = await client.submit("t", "potrf", "L", bad)
+                assert res.info > 0
+            finally:
+                await client.close()
+                await server.close()
+
+        asyncio.run(main())
+        gw.close()
+        router.close()
+    finally:
+        tune.initialize()
+
+
+# ------------------------------------------------- the real 2-process fleet
+
+
+def test_fleet_kill_mid_batch_loses_zero_admitted_requests(tmp_path):
+    """The acceptance core, scaled to a test: two real worker processes,
+    SIGKILL one mid-stream, every admitted request still resolves OK
+    (checkpoint-carried dead-path drain re-dispatches to the sibling,
+    first-result-wins drops late duplicates), and the supervisor's
+    replacement warms from the shared compile cache with ZERO jit
+    compiles (AOT loads only)."""
+    n_requests = 12
+    fleet = serve.Fleet(
+        [serve.TenantConfig("t", max_pending=64)],
+        workers=2, buckets="8", block_size=8, max_batch=4,
+        warm_ops=("potrf",), base_dir=str(tmp_path),
+        heartbeat_s=0.3, backoff_base_s=0.3, backoff_cap_s=5.0,
+        ready_timeout_s=240.0,
+    )
+    try:
+        # both cold workers warmed the same ladder; at least the slower
+        # one must have AOT-loaded what the faster one compiled — and the
+        # point of the shared cache is the RESPAWN below, asserted hard
+        bank = [random_hermitian_pd(6, np.float64, seed=s) for s in range(4)]
+
+        async def drive():
+            async def one(i):
+                return await fleet.gateway.submit(
+                    "t", "potrf", "L", bank[i % len(bank)])
+
+            async def killer():
+                await asyncio.sleep(0.3)
+                faults.process_kill(fleet, "replica0")
+
+            res = await asyncio.gather(*(one(i) for i in range(n_requests)),
+                                       killer())
+            return res[:-1]
+
+        results = asyncio.run(drive())
+        assert len(results) == n_requests
+        assert all(r.info == 0 for r in results)
+        for i, r in enumerate(results):
+            a = bank[i % len(bank)]
+            np.testing.assert_allclose(
+                np.tril(r.x) @ np.tril(r.x).T, a, atol=1e-8)
+
+        # zero lost admitted: every admission resolved, nothing pending
+        st = fleet.stats()
+        t = st["tenants"]["t"]
+        assert t["admitted"] == n_requests
+        assert t["done_ok"] + t["done_err"] == t["admitted"]
+        assert t["pending"] == 0
+
+        # the supervisor respawned replica0 (gen 2) and its warmup hit
+        # the shared compile cache: 0 compiles, AOT loads only
+        h = fleet.handle("replica0")
+        _wait(lambda: h.gen >= 2 and h.ready.is_set(), timeout=120.0,
+              what="replica0 respawn ready")
+        warm = dict(h.ready_info.get("warm") or {})
+        assert warm["compiles"] == 0, warm
+        assert warm["aot_loads"] > 0, warm
+        # and it serves: a request lands after the restart
+        res = asyncio.run(fleet.gateway.submit("t", "potrf", "L", bank[0]))
+        assert res.info == 0
+    finally:
+        fleet.close()
+    # worker JSONL metrics landed in base_dir for the parent merge
+    assert any(p.startswith("worker-replica") for p in os.listdir(tmp_path))
