@@ -23,6 +23,11 @@ three independent, individually opt-in pieces:
                    last N span/serve/health events (live even with JSONL
                    off) dumped atomically on deadline/watchdog/dispatch
                    failures, plus a device-memory watermark sampler.
+  ``obs.telemetry``  live fleet metrics — low-cardinality counter/gauge/
+                   histogram registry (env-gated, no-op when off), the
+                   dual-window SLO burn-rate monitor, and the
+                   service-time harvester that rolls completed-batch
+                   timings into a loadable ``plan`` profile.
   ``obs.export``   ``python -m dlaf_tpu.obs.export`` — merged multi-rank
                    span records to Chrome-trace/Perfetto JSON.
 
@@ -37,10 +42,11 @@ from __future__ import annotations
 import contextlib
 
 from dlaf_tpu.common import stagetimer as _st
-from dlaf_tpu.obs import comms, flight, metrics, spans, trace
+from dlaf_tpu.obs import comms, flight, metrics, spans, telemetry, trace
 from dlaf_tpu.obs.trace import phase, scope
 
-__all__ = ["comms", "flight", "metrics", "spans", "trace", "phase", "scope", "stage"]
+__all__ = ["comms", "flight", "metrics", "spans", "telemetry", "trace",
+           "phase", "scope", "stage"]
 
 
 @contextlib.contextmanager

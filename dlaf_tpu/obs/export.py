@@ -4,11 +4,15 @@
 converts merged multi-rank metrics streams into the Trace Event Format
 that chrome://tracing and https://ui.perfetto.dev load directly:
 
-* each RANK becomes a process row (``pid`` = rank, named ``rank N``);
-* within a rank, spans group into per-TENANT tracks (``tid``) — a span
-  carrying a ``tenant`` attr pins its whole trace to that tenant's track,
-  everything else lands on the ``internal`` track — so a multi-tenant
-  gateway run reads as one lane per tenant per rank;
+* each RANK becomes a process row (``pid`` = rank, named ``rank N``),
+  and each fleet WORKER becomes its own process row — a span record
+  carrying a ``worker`` attr (stamped by the supervisor when it streams
+  or collects a worker's spans) renders as process ``<worker>-g<gen>``
+  regardless of which file it arrived in;
+* within a process row, spans group into per-TENANT tracks (``tid``) —
+  a span carrying a ``tenant`` attr pins its whole trace to that
+  tenant's track, everything else lands on the ``internal`` track — so
+  a multi-tenant gateway run reads as one lane per tenant per process;
 * spans are complete events (``ph:"X"``) with trace/span/parent ids and
   all attrs preserved under ``args`` (Perfetto's flow/args panes);
 * ``comms`` accounting rows become counter events (``ph:"C"``) showing
@@ -16,8 +20,13 @@ that chrome://tracing and https://ui.perfetto.dev load directly:
 * ``health`` records become instant events (``ph:"i"``) so failures line
   up against the request timeline.
 
-Timestamps are microseconds relative to the earliest span start, so the
-viewer opens at t=0 instead of the unix epoch.
+Multiple input files merge into ONE timeline: all records pool before
+conversion, timestamps rebase to the global earliest span start across
+every file (microseconds relative, so the viewer opens at t=0), and
+spans are deduplicated on ``span_id`` — a worker span that was both
+streamed back over the wire and later folded in from the worker's own
+JSONL renders once.  ``--merge`` names this behaviour explicitly for
+scripts; it is also the default whenever several inputs are given.
 """
 from __future__ import annotations
 
@@ -40,16 +49,52 @@ def _tenant_of_trace(spans: list) -> dict:
     return out
 
 
+def dedupe_spans(records: list) -> list:
+    """Drop records whose ``(kind, span_id)`` was already seen — a fleet
+    worker's span can reach the parent stream twice (streamed in a result
+    frame AND folded in from the worker's own JSONL at close).  First
+    occurrence wins; non-span records pass through untouched."""
+    seen: set = set()
+    out = []
+    for rec in records:
+        if rec.get("kind") == "span":
+            sid = rec.get("span_id")
+            if sid is not None:
+                if sid in seen:
+                    continue
+                seen.add(sid)
+        out.append(rec)
+    return out
+
+
 def to_chrome_trace(records: list) -> dict:
     """Build the Trace Event Format document from parsed metrics records
     (any mix of kinds: non-span kinds contribute counters/instants only)."""
+    records = dedupe_spans(records)
     spans = [r for r in records if r.get("kind") == "span"]
     tenants = _tenant_of_trace(spans)
     base_s = min((r["t0_s"] for r in spans), default=0.0)
 
     events = []
     tids: dict = {}  # (pid, track-name) -> tid
-    seen_pids: dict = {}  # pid -> set of track names (for metadata emission)
+    seen_pids: dict = {}  # pid -> list of track names (for metadata emission)
+    pid_names: dict = {}  # pid -> process row name
+    worker_pids: dict = {}  # worker name -> allocated pid
+
+    def _pid(rec) -> int:
+        """Rank pid for plain records; a dedicated row per fleet worker.
+        Worker pids allocate from 1000 up so they never collide with
+        rank numbers."""
+        w = rec.get("worker")
+        if w is None:
+            pid = int(rec.get("rank", 0))
+            pid_names.setdefault(pid, f"rank {pid}")
+            return pid
+        w = str(w)
+        if w not in worker_pids:
+            worker_pids[w] = 1000 + len(worker_pids)
+            pid_names[worker_pids[w]] = w
+        return worker_pids[w]
 
     def _tid(pid: int, track: str) -> int:
         key = (pid, track)
@@ -59,7 +104,7 @@ def to_chrome_trace(records: list) -> dict:
         return tids[key]
 
     for rec in spans:
-        pid = int(rec.get("rank", 0))
+        pid = _pid(rec)
         track = tenants.get(rec["trace_id"], "internal")
         args = {
             k: v
@@ -81,8 +126,8 @@ def to_chrome_trace(records: list) -> dict:
 
     for rec in records:
         kind = rec.get("kind")
-        pid = int(rec.get("rank", 0))
         if kind == "comms":
+            pid = _pid(rec)
             # Cumulative modeled wire bytes per rank: exposed vs overlapped
             # (the overlap window accounting from obs.comms).
             exposed = overlapped = 0.0
@@ -102,6 +147,7 @@ def to_chrome_trace(records: list) -> dict:
                 }
             )
         elif kind == "health":
+            pid = _pid(rec)
             events.append(
                 {
                     "ph": "i",
@@ -117,7 +163,8 @@ def to_chrome_trace(records: list) -> dict:
     meta = []
     for pid, tracks in sorted(seen_pids.items()):
         meta.append(
-            {"ph": "M", "pid": pid, "name": "process_name", "args": {"name": f"rank {pid}"}}
+            {"ph": "M", "pid": pid, "name": "process_name",
+             "args": {"name": pid_names.get(pid, f"rank {pid}")}}
         )
         meta.append({"ph": "M", "pid": pid, "name": "process_sort_index", "args": {"sort_index": pid}})
         for track in tracks:
@@ -141,6 +188,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument("inputs", nargs="+", help="metrics JSONL file(s), already rank-merged or per-rank parts")
     ap.add_argument("-o", "--out", required=True, help="output trace JSON path")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge all inputs into one timeline (explicit name "
+                         "for the multi-input default: pooled records, "
+                         "timestamps rebased to the global earliest span, "
+                         "spans deduplicated on span_id)")
     args = ap.parse_args(argv)
 
     records = []
@@ -150,11 +202,14 @@ def main(argv=None) -> int:
     with open(args.out, "w") as fh:
         json.dump(doc, fh)
         fh.write("\n")
-    n_spans = sum(1 for r in records if r.get("kind") == "span")
-    ranks = sorted({int(r.get("rank", 0)) for r in records if r.get("kind") == "span"})
+    spans = dedupe_spans([r for r in records if r.get("kind") == "span"])
+    ranks = sorted({int(r.get("rank", 0)) for r in spans if "worker" not in r})
+    workers = sorted({str(r["worker"]) for r in spans if "worker" in r})
+    origin = f"ranks {ranks}" + (f", workers {workers}" if workers else "")
     print(
         f"wrote {args.out}: {len(doc['traceEvents'])} events "
-        f"({n_spans} spans, ranks {ranks}) — load in chrome://tracing or ui.perfetto.dev"
+        f"({len(spans)} spans, {len(args.inputs)} input file(s), {origin}) "
+        f"— load in chrome://tracing or ui.perfetto.dev"
     )
     return 0
 

@@ -31,9 +31,12 @@ hit/miss/build/evict/warmup/decision, ``dlaf_tpu.plan``); ``/5`` adds
 the ``fleet`` kind (cross-process serve fleet lifecycle — worker spawn/
 ready/exit/restart, circuit breaker, failover re-dispatch, autoscale
 decisions with their triggering signals, child flight-dump collection;
-``dlaf_tpu.serve.supervisor`` / ``serve.fleet``).
-Writers stamp ``/5``; readers (:func:`validate_record`,
-:func:`read_jsonl`) accept all five so old BENCH and metrics artifacts
+``dlaf_tpu.serve.supervisor`` / ``serve.fleet``); ``/6`` adds the
+``telemetry`` kind (live instrument-registry snapshots — fleet-merged
+counters/gauges/histograms, ``obs.telemetry``) and the ``slo_burn``
+kind (dual-window error-budget burn-rate transitions per tenant).
+Writers stamp ``/6``; readers (:func:`validate_record`,
+:func:`read_jsonl`) accept all six so old BENCH and metrics artifacts
 keep parsing.
 """
 from __future__ import annotations
@@ -44,10 +47,10 @@ import sys
 import threading
 import time
 
-SCHEMA = "dlaf_tpu.obs/5"
-#: every schema tag a reader accepts (old artifacts carry /1 - /4).
+SCHEMA = "dlaf_tpu.obs/6"
+#: every schema tag a reader accepts (old artifacts carry /1 - /5).
 SCHEMAS = ("dlaf_tpu.obs/1", "dlaf_tpu.obs/2", "dlaf_tpu.obs/3",
-           "dlaf_tpu.obs/4", "dlaf_tpu.obs/5")
+           "dlaf_tpu.obs/4", "dlaf_tpu.obs/5", "dlaf_tpu.obs/6")
 
 #: kind -> payload fields every record of that kind must carry.
 REQUIRED_FIELDS: dict = {
@@ -73,6 +76,9 @@ REQUIRED_FIELDS: dict = {
     "plan": ("event",),
     # /5 additions:
     "fleet": ("event",),
+    # /6 additions:
+    "telemetry": ("snapshot",),
+    "slo_burn": ("tenant", "fast_burn", "slow_burn", "firing"),
 }
 
 _emitter = None
@@ -82,6 +88,10 @@ _listeners_registered = False
 # emitter is active.  None = off (the common case; emit() stays two
 # module-global tests on the off path).
 _tee = None
+# Additional record taps (fleet workers buffering span records for wire
+# streaming).  Unlike the single-slot tee this is a list; None when empty
+# so the off path stays one module-global test.
+_taps = None
 
 
 class MetricsEmitter:
@@ -162,12 +172,15 @@ def get() -> MetricsEmitter | None:
 
 
 def emit(kind: str, **fields) -> None:
-    """Emit one record on the active sinks (JSONL stream and/or flight
-    tee); no-op when both are off."""
+    """Emit one record on the active sinks (JSONL stream, flight tee,
+    registered taps); no-op when all are off."""
     if _emitter is not None:
         _emitter.emit(kind, **fields)
     if _tee is not None:
         _tee(kind, fields)
+    if _taps is not None:
+        for tap in _taps:
+            tap(kind, fields)
 
 
 def set_tee(fn) -> None:
@@ -177,9 +190,26 @@ def set_tee(fn) -> None:
     _tee = fn
 
 
+def add_tap(fn) -> None:
+    """Register an additional record sink, called as ``fn(kind, fields)``
+    for every emitted record (the fleet worker's span-streaming buffer).
+    Multiple taps coexist — unlike the single-slot flight tee."""
+    global _taps
+    taps = list(_taps or ())
+    taps.append(fn)
+    _taps = taps
+
+
+def remove_tap(fn) -> None:
+    """Unregister a tap installed by :func:`add_tap` (no-op if absent)."""
+    global _taps
+    taps = [t for t in (_taps or ()) if t is not fn]
+    _taps = taps or None
+
+
 def sinking() -> bool:
     """True when at least one sink would receive an emitted record."""
-    return _emitter is not None or _tee is not None
+    return _emitter is not None or _tee is not None or _taps is not None
 
 
 def close() -> None:

@@ -1,0 +1,584 @@
+"""Live fleet telemetry: counters/gauges/histograms, SLO burn rate, harvesting.
+
+``obs.metrics`` is a write-only audit trail — you learn what happened
+after the JSONL is closed and parsed.  A fleet of worker OS processes
+needs *live* signals too: how many requests were shed in the last
+minute, what the wire is moving, whether a tenant is burning its error
+budget fast enough to page.  This module is that plane, three pieces:
+
+* **instrument registry** — :func:`counter` / :func:`gauge` /
+  :func:`histogram` hand out low-cardinality instruments keyed by
+  ``(name, sorted labels)``.  Off by default: every accessor returns one
+  shared no-op object after a single flag test, so instrumented hot
+  paths (gateway admission, wire codec, pool dispatch) pay one branch
+  when telemetry is off.  On, updates take a module lock held only for
+  the arithmetic — the same held-briefly discipline as
+  ``MetricsEmitter``.  :func:`snapshot` serializes the whole registry to
+  a plain dict (the ``telemetry`` wire-frame payload and obs record),
+  :func:`merge` folds worker snapshots into a fleet view, and
+  :func:`render_text` prints the Prometheus-style scrape format that
+  ``scripts/telemetry_serve.py`` serves.
+
+* **SLO burn-rate monitor** — :class:`SloBurnMonitor` keeps a sliding
+  dual window (fast/slow) of per-tenant request outcomes (latency over
+  target, or shed) and converts the windowed bad-fraction into an
+  error-budget *burn rate* (1.0 = exactly consuming the budget).  When
+  BOTH windows burn above the threshold the tenant is "firing" — the
+  classic multi-window multi-burn alert shape: the fast window catches
+  the page-worthy spike, the slow window stops a blip from paging.
+  Transitions emit ``slo_burn`` obs records, and :meth:`hot` is the
+  third autoscaler input next to p95 and queue depth.
+
+* **service-time harvester** — :class:`ServiceTimeHarvester` rolls
+  completed-batch telemetry into a ``dlaf_tpu.plan.profile/1``
+  compatible JSON per (op, bucket, dtype), so ``plan/autotune.decide``
+  consults measured fleet service times instead of analytical defaults
+  (the tritonBLAS argument: measured per-geometry profiles should steer
+  selection at scale).
+
+Everything here is host-side orchestration state; never touch it inside
+a ``jit``/``shard_map`` body.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import json
+import threading
+import time
+
+from dlaf_tpu.obs import metrics as om
+
+# Default histogram bucket upper bounds (seconds-flavoured exponential
+# ladder; the +inf bucket is implicit as the final count slot).
+DEFAULT_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+SNAPSHOT_SCHEMA = "dlaf_tpu.telemetry/1"
+
+_on = False
+_lock = threading.Lock()
+# (name, labels-tuple) -> instrument; one dict per family keeps snapshot
+# serialization trivial and key collisions across families impossible.
+_counters: dict = {}
+_gauges: dict = {}
+_hists: dict = {}
+
+
+def enable() -> None:
+    """Turn the registry on (instrument accessors mint real instruments)."""
+    global _on
+    _on = True
+
+
+def disable() -> None:
+    global _on
+    _on = False
+
+
+def enabled() -> bool:
+    return _on
+
+
+def reset() -> None:
+    """Drop every registered instrument (tests and fleet teardown)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+
+
+def _key(name: str, labels: dict) -> tuple:
+    return (str(name), tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+class _Noop:
+    """Shared do-nothing instrument handed out while telemetry is off."""
+
+    __slots__ = ()
+
+    def inc(self, v: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NOOP = _Noop()
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        with _lock:
+            self.value += v
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with _lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Fixed-bound bucket histogram (Prometheus-shaped): per-bucket
+    counts plus count/sum/min/max.  Percentiles come from the bucket
+    upper bounds (:func:`percentile`), so memory is O(len(bounds))
+    regardless of observation count."""
+
+    __slots__ = ("bounds", "buckets", "count", "sum", "min", "max")
+
+    def __init__(self, bounds=DEFAULT_BOUNDS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.buckets = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with _lock:
+            self.buckets[i] += 1
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+
+def counter(name: str, **labels) -> Counter:
+    """The counter instrument for ``(name, labels)`` (shared no-op when
+    telemetry is off — callers never branch)."""
+    if not _on:
+        return _NOOP
+    key = _key(name, labels)
+    with _lock:
+        inst = _counters.get(key)
+        if inst is None:
+            inst = _counters[key] = Counter()
+    return inst
+
+
+def gauge(name: str, **labels) -> Gauge:
+    if not _on:
+        return _NOOP
+    key = _key(name, labels)
+    with _lock:
+        inst = _gauges.get(key)
+        if inst is None:
+            inst = _gauges[key] = Gauge()
+    return inst
+
+
+def histogram(name: str, bounds=DEFAULT_BOUNDS, **labels) -> Histogram:
+    if not _on:
+        return _NOOP
+    key = _key(name, labels)
+    with _lock:
+        inst = _hists.get(key)
+        if inst is None:
+            inst = _hists[key] = Histogram(bounds)
+    return inst
+
+
+# ------------------------------------------------------------- snapshots
+
+
+def _series(key: tuple) -> str:
+    """``name{k=v,...}`` — the stable string form a snapshot keys on."""
+    name, labels = key
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def snapshot() -> dict:
+    """Serialize the whole registry to a JSON-safe dict — the payload of
+    the ``telemetry`` wire frame, the ``telemetry`` obs record, and the
+    scrape endpoint."""
+    with _lock:
+        counters = {_series(k): c.value for k, c in _counters.items()}
+        gauges = {_series(k): g.value for k, g in _gauges.items()}
+        hists = {}
+        for k, h in _hists.items():
+            hists[_series(k)] = {
+                "bounds": list(h.bounds),
+                "buckets": list(h.buckets),
+                "count": h.count,
+                "sum": h.sum,
+                "min": h.min,
+                "max": h.max,
+            }
+    return {"schema": SNAPSHOT_SCHEMA, "counters": counters,
+            "gauges": gauges, "hists": hists}
+
+
+def merge(*snaps: dict) -> dict:
+    """Fold snapshots into one fleet view: counters and histogram buckets
+    add, gauges keep the last non-None writer (snapshots arrive ordered
+    parent-first, workers after — last wins is freshest-wins)."""
+    out = {"schema": SNAPSHOT_SCHEMA, "counters": {}, "gauges": {}, "hists": {}}
+    for snap in snaps:
+        if not snap:
+            continue
+        for k, v in snap.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0.0) + v
+        for k, v in snap.get("gauges", {}).items():
+            out["gauges"][k] = v
+        for k, h in snap.get("hists", {}).items():
+            cur = out["hists"].get(k)
+            if cur is None or list(cur["bounds"]) != list(h["bounds"]):
+                out["hists"][k] = {
+                    "bounds": list(h["bounds"]),
+                    "buckets": list(h["buckets"]),
+                    "count": h["count"], "sum": h["sum"],
+                    "min": h["min"], "max": h["max"],
+                }
+                continue
+            cur["buckets"] = [a + b for a, b in zip(cur["buckets"], h["buckets"])]
+            cur["count"] += h["count"]
+            cur["sum"] += h["sum"]
+            mins = [m for m in (cur["min"], h["min"]) if m is not None]
+            maxs = [m for m in (cur["max"], h["max"]) if m is not None]
+            cur["min"] = min(mins) if mins else None
+            cur["max"] = max(maxs) if maxs else None
+    return out
+
+
+def percentile(hist: dict, q: float) -> float | None:
+    """Estimate the ``q`` (0..1) percentile of a snapshot histogram from
+    its bucket upper bounds (the tail bucket reports the observed max).
+    None on an empty histogram."""
+    count = int(hist.get("count", 0))
+    if count <= 0:
+        return None
+    rank = max(1, int(q * count + 0.999999))  # nearest-rank, 1-based
+    seen = 0
+    bounds = hist["bounds"]
+    for i, c in enumerate(hist["buckets"]):
+        seen += c
+        if seen >= rank:
+            if i < len(bounds):
+                return float(bounds[i])
+            return float(hist["max"]) if hist["max"] is not None else float(bounds[-1])
+    return float(hist["max"]) if hist["max"] is not None else None
+
+
+def render_text(snap: dict | None = None) -> str:
+    """Prometheus-style plain-text exposition of a snapshot (default: the
+    live registry).  One ``name{labels} value`` line per series; each
+    histogram renders its cumulative buckets plus ``_count``/``_sum`` and
+    derived p50/p95/p99 gauge lines (scrapers without histogram math
+    still get percentiles)."""
+    if snap is None:
+        snap = snapshot()
+    lines = [f"# dlaf_tpu telemetry {snap.get('schema', SNAPSHOT_SCHEMA)}"]
+    for k in sorted(snap.get("counters", {})):
+        lines.append(f"{k} {snap['counters'][k]:g}")
+    for k in sorted(snap.get("gauges", {})):
+        lines.append(f"{k} {snap['gauges'][k]:g}")
+    for k in sorted(snap.get("hists", {})):
+        h = snap["hists"][k]
+        base, _, labels = k.partition("{")
+        labels = ("," + labels[:-1]) if labels else ""
+        cum = 0
+        for bound, c in zip(h["bounds"], h["buckets"]):
+            cum += c
+            lines.append(f'{base}_bucket{{le={bound:g}{labels}}} {cum}')
+        lines.append(f'{base}_bucket{{le=+Inf{labels}}} {h["count"]}')
+        lines.append(f"{base}_count{{{labels[1:]}}} {h['count']}" if labels
+                     else f"{base}_count {h['count']}")
+        lines.append(f"{base}_sum{{{labels[1:]}}} {h['sum']:g}" if labels
+                     else f"{base}_sum {h['sum']:g}")
+        for q, tag in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            p = percentile(h, q)
+            if p is not None:
+                lines.append(f"{base}_{tag}{{{labels[1:]}}} {p:g}" if labels
+                             else f"{base}_{tag} {p:g}")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------ SLO burn monitor
+
+
+class SloBurnMonitor:
+    """Sliding dual-window error-budget burn per tenant.
+
+    A request outcome is *bad* when it was shed or its latency exceeded
+    ``p95_target_s``.  Burn rate over a window is
+    ``bad_fraction / budget`` — 1.0 means the tenant is consuming its
+    error budget exactly as fast as allowed; 2.0 means twice as fast.
+    The monitor fires for a tenant only when BOTH the fast and the slow
+    window burn at or above ``threshold`` (multi-window: fast catches
+    the spike, slow suppresses blips), and emits an ``slo_burn`` obs
+    record on every firing-state transition with both rates, the
+    windowed p95/p99, and the shed fraction.
+
+    ``clock`` is injectable for deterministic window math in tests.
+    """
+
+    def __init__(self, *, p95_target_s: float, budget: float = 0.05,
+                 fast_s: float = 60.0, slow_s: float = 600.0,
+                 threshold: float = 2.0, clock=time.monotonic):
+        if budget <= 0:
+            raise ValueError(f"slo burn budget must be > 0, got {budget}")
+        self.p95_target_s = float(p95_target_s)
+        self.budget = float(budget)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.threshold = float(threshold)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # tenant -> deque of (t, bad, shed, latency_s|None); pruned to slow_s
+        self._events: dict = collections.defaultdict(collections.deque)
+        self._firing: dict = {}
+
+    def record(self, tenant: str, latency_s: float | None = None, *,
+               shed: bool = False) -> None:
+        """One request outcome for ``tenant`` (latency of a completed
+        request, or ``shed=True`` for an admission-rejected one)."""
+        bad = bool(shed) or (latency_s is not None
+                             and float(latency_s) > self.p95_target_s)
+        now = self._clock()
+        with self._lock:
+            dq = self._events[str(tenant)]
+            dq.append((now, bad, bool(shed), latency_s))
+            cutoff = now - self.slow_s
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+
+    def _window(self, dq, now: float, horizon: float) -> tuple:
+        """(total, bad, shed, latencies) over [now - horizon, now]."""
+        total = bad = shed = 0
+        lats = []
+        cutoff = now - horizon
+        for t, b, s, lat in reversed(dq):
+            if t < cutoff:
+                break
+            total += 1
+            bad += b
+            shed += s
+            if lat is not None:
+                lats.append(lat)
+        return total, bad, shed, lats
+
+    def check(self) -> dict:
+        """Evaluate every tenant; returns ``{tenant: burn-state dict}``
+        and emits ``slo_burn`` obs records on firing transitions."""
+        now = self._clock()
+        out = {}
+        transitions = []
+        with self._lock:
+            for tenant, dq in self._events.items():
+                f_tot, f_bad, f_shed, f_lats = self._window(dq, now, self.fast_s)
+                s_tot, s_bad, s_shed, s_lats = self._window(dq, now, self.slow_s)
+                fast_burn = (f_bad / f_tot / self.budget) if f_tot else 0.0
+                slow_burn = (s_bad / s_tot / self.budget) if s_tot else 0.0
+                firing = fast_burn >= self.threshold and slow_burn >= self.threshold
+                s_lats.sort()
+                state = {
+                    "tenant": tenant,
+                    "fast_burn": fast_burn,
+                    "slow_burn": slow_burn,
+                    "firing": firing,
+                    "p95_s": pct_sorted(s_lats, 0.95),
+                    "p99_s": pct_sorted(s_lats, 0.99),
+                    "shed_frac": (s_shed / s_tot) if s_tot else 0.0,
+                    "window_fast_s": self.fast_s,
+                    "window_slow_s": self.slow_s,
+                    "p95_target_s": self.p95_target_s,
+                    "budget": self.budget,
+                    "threshold": self.threshold,
+                }
+                out[tenant] = state
+                if firing != self._firing.get(tenant, False):
+                    self._firing[tenant] = firing
+                    transitions.append(state)
+        for state in transitions:
+            om.emit("slo_burn", **state)
+        return out
+
+    def hot(self) -> bool:
+        """True while any tenant is firing — the autoscaler's third
+        input next to p95 and queue depth (callers should :meth:`check`
+        first; this only reads the latched state)."""
+        with self._lock:
+            return any(self._firing.values())
+
+
+def pct_sorted(sorted_vals: list, q: float) -> float | None:
+    """Nearest-rank percentile of an ascending list (None when empty)."""
+    if not sorted_vals:
+        return None
+    i = max(0, min(len(sorted_vals) - 1, int(q * len(sorted_vals) + 0.999999) - 1))
+    return float(sorted_vals[i])
+
+
+# --------------------------------------------------- service-time harvest
+
+
+class ServiceTimeHarvester:
+    """Roll completed-batch service times into a loadable plan profile.
+
+    Every dispatched batch contributes one observation to its
+    ``(op, bucket-n, dtype)`` geometry; :meth:`profile` renders the
+    geometries with at least ``min_samples`` observations as a
+    ``dlaf_tpu.plan.profile/1`` document whose ``choice`` block records
+    the launch parameters that actually served the traffic (so
+    ``plan/autotune.decide`` resolves them with ``source='profile'``)
+    and whose ``measured`` block carries the service-time statistics the
+    capacity model fits.  :meth:`write` persists it — point
+    ``DLAF_TPU_PLAN_PROFILE`` at the file and the next
+    ``tune.initialize()`` steers from measured fleet data.
+    """
+
+    def __init__(self, *, min_samples: int = 8):
+        self.min_samples = int(min_samples)
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+
+    def observe(self, op: str, n: int, dtype, batch: int, seconds: float, *,
+                nb: int | None = None, shard_batch: bool | None = None) -> None:
+        """One completed batch: ``seconds`` wall time serving ``batch``
+        items of geometry ``(op, n, dtype)`` under launch params
+        ``nb``/``shard_batch`` (None = record the analytic default)."""
+        import numpy as np
+
+        key = (str(op), int(n), np.dtype(dtype).str)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = {
+                    "count": 0, "items": 0, "total_s": 0.0,
+                    "batch_s": [], "nb": None, "shard_batch": None,
+                }
+            e["count"] += 1
+            e["items"] += int(batch)
+            e["total_s"] += float(seconds)
+            if len(e["batch_s"]) < 4096:  # bound memory on long runs
+                e["batch_s"].append(float(seconds))
+            if nb is not None:
+                e["nb"] = int(nb)
+            if shard_batch is not None:
+                e["shard_batch"] = bool(shard_batch)
+
+    def ingest(self, records) -> int:
+        """Feed ``serve``/``batch`` obs records (parent stream, or a
+        fleet-merged JSONL) into the harvest; records without the
+        geometry fields (pre-/6 writers) are skipped.  Returns the number
+        of batches ingested."""
+        fed = 0
+        for rec in records:
+            if rec.get("kind") != "serve" or rec.get("event") != "batch":
+                continue
+            if "dtype" not in rec or "n" not in rec:
+                continue
+            self.observe(
+                rec.get("op", "?"), int(rec["n"]), rec["dtype"],
+                int(rec.get("batch", 1)), float(rec.get("seconds", 0.0)),
+                nb=rec.get("nb"), shard_batch=rec.get("shard_batch"),
+            )
+            fed += 1
+        return fed
+
+    def profile(self) -> dict:
+        """The ``dlaf_tpu.plan.profile/1`` document for every geometry
+        with >= ``min_samples`` batches (empty ``entries`` otherwise)."""
+        from dlaf_tpu.plan.autotune import PROFILE_SCHEMA
+
+        entries = []
+        with self._lock:
+            items = sorted(self._entries.items())
+        for (op, n, ds), e in items:
+            if e["count"] < self.min_samples:
+                continue
+            choice = {}
+            if e["nb"] is not None:
+                choice["nb"] = e["nb"]
+            if e["shard_batch"] is not None:
+                choice["shard_batch"] = e["shard_batch"]
+            lats = sorted(e["batch_s"])
+            entries.append({
+                "op": op, "n": n, "dtype": ds,
+                "choice": choice,
+                "measured": {
+                    "batches": e["count"],
+                    "items": e["items"],
+                    "mean_batch_s": e["total_s"] / e["count"],
+                    "mean_item_s": e["total_s"] / max(e["items"], 1),
+                    "p95_batch_s": pct_sorted(lats, 0.95),
+                },
+            })
+        return {
+            "schema": PROFILE_SCHEMA,
+            "entries": entries,
+            "harvest": {"source": "fleet-telemetry",
+                        "min_samples": self.min_samples,
+                        "geometries_seen": len(items)},
+        }
+
+    def write(self, path: str) -> dict | None:
+        """Persist the profile to ``path`` and emit a ``plan``
+        ``harvest`` obs record; returns the document, or None (writing
+        nothing) when no geometry reached ``min_samples`` — a profile
+        with zero entries must not shadow a real one on disk."""
+        prof = self.profile()
+        if not prof["entries"]:
+            return None
+        with open(path, "w") as fh:
+            json.dump(prof, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        om.emit("plan", event="harvest", path=str(path),
+                entries=len(prof["entries"]),
+                geometries_seen=prof["harvest"]["geometries_seen"])
+        return prof
+
+
+# ---------------------------------------------------------- http scrape
+
+
+def serve_scrape(port: int, snapshot_fn=None, host: str = "127.0.0.1"):
+    """Start a daemon-thread HTTP server exposing the plain-text scrape
+    at ``/`` (and ``/metrics``).  ``snapshot_fn`` overrides the payload
+    source (the fleet passes its merged view); default is this process's
+    registry.  Returns the ``http.server`` instance (``.shutdown()`` to
+    stop; ``.server_address[1]`` for the bound port when ``port=0``)."""
+    import http.server
+
+    fn = snapshot_fn or snapshot
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib handler casing)
+            if self.path not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = render_text(fn()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet: scrapes are not events
+            pass
+
+    srv = http.server.ThreadingHTTPServer((host, int(port)), _Handler)
+    t = threading.Thread(target=srv.serve_forever, name="dlaf-telemetry-scrape",
+                         daemon=True)
+    t.start()
+    return srv

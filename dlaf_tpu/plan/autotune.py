@@ -65,6 +65,15 @@ def load_profile(path: str | None = None):
     _fingerprint = hashlib.sha1(
         json.dumps(prof, sort_keys=True).encode()
     ).hexdigest()[:10]
+    from dlaf_tpu.obs import metrics as om
+
+    harvest = prof.get("harvest")
+    om.emit(
+        "plan", event="profile_loaded", path=str(path),
+        fingerprint=_fingerprint, entries=len(prof.get("entries", ())),
+        harvested=harvest is not None,
+        **({"harvest_source": harvest.get("source")} if isinstance(harvest, dict) else {}),
+    )
     return prof
 
 

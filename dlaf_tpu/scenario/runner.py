@@ -352,6 +352,7 @@ class ScenarioResult:
     stats: dict
     elapsed_s: float
     failures: list
+    chains: dict | None = None
 
     @property
     def passed(self) -> bool:
@@ -422,6 +423,45 @@ def evaluate_autoscale(actions: list, max_actions: int = 6) -> list:
 
 #: fault kinds that only make sense against real worker processes.
 _FLEET_ONLY_FAULTS = ("process_kill", "network_partition")
+
+#: spans every completed FLEET request must show under its gateway root:
+#: the wire hop plus the worker-side queue/solve — i.e. the trace context
+#: survived the process boundary in both directions.
+FLEET_CHAIN = ("wire.submit", "pool.queue", "serve.solve")
+
+#: in-process equivalent (the run_loadgen chain, minus the wire hop).
+LOCAL_CHAIN = ("gw.queue", "gw.batch", "gw.dispatch", "pool.queue",
+               "serve.solve")
+
+#: minimum fraction of completed requests with a full cross-process chain
+#: for a fleet run to pass (the CI serve-fleet lane's chain SLO).
+CHAIN_SLO = 0.95
+
+
+def trace_chain_stats(records: list, *, fleet: bool = False) -> dict:
+    """Chain-completeness over a finished run's records: of the completed
+    (outcome ok) ``gw.request`` roots, how many traces carry the full
+    span chain — :data:`FLEET_CHAIN` across the process boundary in fleet
+    mode, :data:`LOCAL_CHAIN` in-process otherwise.  Spans are
+    deduplicated first (fleet workers stream spans back AND fold them in
+    from their own JSONL at close)."""
+    from dlaf_tpu.obs import export as oexport
+
+    spans = oexport.dedupe_spans(
+        [r for r in records if r.get("kind") == "span"])
+    names_by_trace = defaultdict(set)
+    for s in spans:
+        names_by_trace[s["trace_id"]].add(s["name"])
+    roots = [s for s in spans
+             if s["name"] == "gw.request" and s.get("outcome") == "ok"]
+    need = set(FLEET_CHAIN if fleet else LOCAL_CHAIN)
+    full = sum(1 for r in roots if need <= names_by_trace[r["trace_id"]])
+    return {
+        "roots": len(roots),
+        "full": full,
+        "frac": (full / len(roots)) if roots else 0.0,
+        "need": sorted(need),
+    }
 
 
 def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
@@ -549,6 +589,17 @@ def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
     elapsed = time.monotonic() - t0
 
     failures = evaluate_slos(scenario, counts, stats, n) + autoscale_fails
+    chains = None
+    if out and trace_out:
+        chains = trace_chain_stats(om.read_jsonl(out), fleet=fleet)
+        om.emit("scenario", event="trace_chains", scenario=scenario.name,
+                fleet=bool(fleet), **chains)
+        if fleet and (chains["roots"] == 0
+                      or chains["frac"] < CHAIN_SLO):
+            failures.append(
+                f"trace chains: {chains['full']}/{chains['roots']} completed "
+                f"requests carried the full cross-process span chain "
+                f"({FLEET_CHAIN}) — below {CHAIN_SLO:.0%}")
     om.emit("scenario", event="result", scenario=scenario.name,
             seed=scenario.seed, requests=n, elapsed_s=elapsed,
             passed=not failures, failures=failures, counts=counts,
@@ -560,7 +611,8 @@ def run_scenario(scenario: sspec.Scenario, *, requests: int | None = None,
         om.close()
 
     result = ScenarioResult(scenario=scenario, requests=n, counts=counts,
-                            stats=stats, elapsed_s=elapsed, failures=failures)
+                            stats=stats, elapsed_s=elapsed, failures=failures,
+                            chains=chains)
     if not quiet:
         print_scenario_result(result)
     return result
@@ -601,6 +653,10 @@ def print_scenario_result(result: ScenarioResult) -> None:
         print(f"   worker {name:>9s} gen={w['gen']:<3d} served={w['served']:<6d} "
               f"failures={w['failures']:<3d} "
               f"circuit={'OPEN' if w['circuit_open'] else 'closed'}")
+    if result.chains is not None and result.chains["roots"]:
+        c = result.chains
+        print(f"   trace chains: {c['full']}/{c['roots']} complete "
+              f"({c['frac']:.0%}) over {c['need']}")
     for f in result.failures:
         print(f"   SLO FAIL: {f}")
     print(("PASS" if result.passed else "FAIL") + f"  scenario {scn.name}")

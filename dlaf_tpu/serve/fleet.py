@@ -46,6 +46,7 @@ import time
 
 from dlaf_tpu.health import DeviceUnresponsiveError, DistributionError
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import telemetry as tlm
 from dlaf_tpu.serve.gateway import Gateway
 from dlaf_tpu.serve.router import Replica, Router
 from dlaf_tpu.serve.supervisor import (
@@ -56,7 +57,10 @@ from dlaf_tpu.serve.supervisor import (
     xla_flags_with_device_count,
 )
 
-_WORKER_METRICS_RE = re.compile(r"worker-(.+)-g\d+\.jsonl$")
+#: captures ``<name>-g<gen>`` — merged records (and the export's process
+#: rows) identify the worker INCARNATION, so a respawned replica's spans
+#: land on their own timeline row instead of aliasing its predecessor's.
+_WORKER_METRICS_RE = re.compile(r"worker-(.+-g\d+)\.jsonl$")
 
 
 class Fleet:
@@ -97,6 +101,11 @@ class Fleet:
                 os.environ.get("XLA_FLAGS"), worker_devices
             ),
         }
+        if tlm.enabled():
+            # a telemetry-on parent turns its workers on too: their
+            # tune.initialize flips the registry from this env, and their
+            # snapshots ride heartbeat acks back into Fleet.stats()
+            env["DLAF_TPU_TELEMETRY"] = "1"
         self.probe_budget_s = float(probe_budget_s)
         self.ready_timeout_s = float(ready_timeout_s)
         self._fake = fake
@@ -126,6 +135,19 @@ class Fleet:
         self.gateway = Gateway(self.router, tenants,
                                max_queue=gw_max_queue, max_batch=max_batch,
                                linger_ms=linger_ms)
+        # SLO burn-rate monitor (obs.telemetry): the gateway feeds it every
+        # shed/completion; tick() evaluates it; its latched verdict is the
+        # autoscaler's third input next to p95 and queue depth
+        from dlaf_tpu.tune import get_tune_parameters
+
+        p = get_tune_parameters()
+        self.burn_monitor = tlm.SloBurnMonitor(
+            p95_target_s=p.slo_burn_target_p95_s, budget=p.slo_burn_budget,
+            fast_s=p.slo_burn_fast_s, slow_s=p.slo_burn_slow_s,
+            threshold=p.slo_burn_threshold,
+        )
+        self.gateway.burn_monitor = self.burn_monitor
+        self.profile_path: str | None = None  # written by close() harvest
         self.supervisor.start_monitor()
         self.autoscaler = None
         if autoscale:
@@ -133,7 +155,8 @@ class Fleet:
                 self._signals, self.live_workers,
                 self.scale_up, self.scale_down,
                 min_workers=int(min_workers), max_workers=int(max_workers),
-                **(autoscale_kwargs or {}),
+                **{"burn_fn": self.burn_monitor.hot,
+                   **(autoscale_kwargs or {})},
             )
 
     # -------------------------------------------------------------- workers
@@ -198,10 +221,12 @@ class Fleet:
         self.gateway.check_replicas(self.probe_budget_s)
 
     def tick(self) -> dict:
-        """One fleet maintenance pass: probe/drain/revive sweep plus an
-        autoscaler step.  The scenario runner (and any serving loop) calls
-        this periodically."""
+        """One fleet maintenance pass: probe/drain/revive sweep, a burn-
+        rate evaluation (emitting ``slo_burn`` transitions), then an
+        autoscaler step over all three signals.  The scenario runner (and
+        any serving loop) calls this periodically."""
         summary = self.gateway.check_replicas(self.probe_budget_s)
+        self.burn_monitor.check()
         if self.autoscaler is not None:
             self.autoscaler.step()
         return summary
@@ -282,10 +307,20 @@ class Fleet:
         st["workers"] = {
             h.name: {"gen": h.gen, "alive": h.alive, "served": h.served,
                      "failures": h.failures, "circuit_open": h.circuit_open,
-                     "pending": h.pending()}
+                     "pending": h.pending(), "hb_rtt_p95_s": h.rtt_p95_s()}
             for h in self.supervisor.handles()
         }
+        st["slo_burn"] = self.burn_monitor.check()
+        if tlm.enabled():
+            st["telemetry"] = self.merged_telemetry()
         return st
+
+    def merged_telemetry(self) -> dict:
+        """One fleet-wide instrument view: the parent registry folded with
+        every worker's latest heartbeat-carried snapshot."""
+        snaps = [h.last_telemetry for h in self.supervisor.handles()
+                 if h.last_telemetry]
+        return tlm.merge(tlm.snapshot(), *snaps)
 
     def close(self, timeout: float | None = 60.0) -> None:
         if self._closed:
@@ -295,9 +330,13 @@ class Fleet:
         for h in self.supervisor.handles():
             om.emit("fleet", event="worker_stats", worker=h.name,
                     served=h.served, gen=h.gen, failures=h.failures,
-                    circuit_open=h.circuit_open)
+                    circuit_open=h.circuit_open, rtt_p95_s=h.rtt_p95_s())
+        if tlm.enabled():
+            om.emit("telemetry", snapshot=self.merged_telemetry(),
+                    scope="fleet")
         self.supervisor.close()
         self._merge_worker_metrics()
+        self._harvest_service_times()
 
     def _merge_worker_metrics(self) -> None:
         """Fold each worker's JSONL (written in the child) into the parent
@@ -320,6 +359,30 @@ class Fleet:
                           if k not in ("schema", "kind")}
                 fields.setdefault("worker", worker)
                 om.emit(rec["kind"], **fields)
+
+    def _harvest_service_times(self) -> None:
+        """Roll the merged stream's completed-batch records (the workers'
+        ``serve``/``batch`` events carry geometry + launch choice) into a
+        persisted ``plan`` profile.  Point ``DLAF_TPU_PLAN_PROFILE`` at
+        ``profile_path`` and the next run's ``plan/autotune.decide``
+        resolves those geometries with ``source='profile'`` — real fleet
+        data steering the analytic model."""
+        em = om.get()
+        if em is None:
+            return
+        from dlaf_tpu.tune import get_tune_parameters
+
+        harvester = tlm.ServiceTimeHarvester(
+            min_samples=get_tune_parameters().telemetry_harvest_min_samples)
+        try:
+            fed = harvester.ingest(om.read_jsonl(em.path))
+        except (OSError, ValueError):
+            return
+        if not fed:
+            return
+        path = os.path.join(self.base_dir, "harvested-profile.json")
+        if harvester.write(path) is not None:
+            self.profile_path = path
 
     def __enter__(self):
         return self

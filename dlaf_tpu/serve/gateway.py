@@ -54,6 +54,7 @@ from dlaf_tpu.health import (
 from dlaf_tpu.obs import flight as oflight
 from dlaf_tpu.obs import metrics as om
 from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import telemetry as tlm
 from dlaf_tpu.serve import qos
 from dlaf_tpu.serve.pool import make_request
 from dlaf_tpu.serve.router import Replica, Router
@@ -130,6 +131,10 @@ class Gateway:
             for n in self.tenants
         }
         self._gw = {"batches": 0, "dispatched": 0, "fill_sum": 0.0}
+        # optional obs.telemetry.SloBurnMonitor: when set (Fleet wires it
+        # from the slo_burn_* tune knobs), every admission shed and every
+        # completion outcome feeds the dual-window burn accounting
+        self.burn_monitor = None
         self._hold_until = 0.0              # backend-full / no-replica backoff
         self._closed = False
         self._dispatcher = threading.Thread(
@@ -163,6 +168,8 @@ class Gateway:
                 c["shed_full"] += 1
                 om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
                         scope="tenant")
+                tlm.counter("gw_shed", tenant=tenant, reason="full").inc()
+                self._record_burn(tenant, shed=True)
                 raise QueueFullError(
                     self._pending[tenant], cfg.max_pending,
                     message=(
@@ -173,6 +180,8 @@ class Gateway:
             if not self._buckets[tenant].try_take():
                 c["shed_quota"] += 1
                 om.emit("serve", event="gw_shed_quota", tenant=tenant, op=kind)
+                tlm.counter("gw_shed", tenant=tenant, reason="quota").inc()
+                self._record_burn(tenant, shed=True)
                 raise TenantQuotaExceededError(tenant, cfg.rate or 0.0)
             if self._queued_locked() >= self.max_queue:
                 self._make_room_locked(cfg)
@@ -184,8 +193,11 @@ class Gateway:
                 c["shed_full"] += 1
                 om.emit("serve", event="gw_shed_full", tenant=tenant, op=kind,
                         scope="gateway")
+                tlm.counter("gw_shed", tenant=tenant, reason="full").inc()
+                self._record_burn(tenant, shed=True)
                 raise QueueFullError(self._queued_locked(), self.max_queue)
             c["admitted"] += 1
+            tlm.counter("gw_admitted", tenant=tenant).inc()
             self._pending[tenant] += 1
             # span root opens at admission, anchored at t_submit so the
             # validation cost is inside the request interval; set BEFORE
@@ -280,10 +292,17 @@ class Gateway:
         self._remove_forming_locked(worst[0], worst[1])
         return worst[1]
 
+    def _record_burn(self, tenant: str, latency_s: float | None = None, *,
+                     shed: bool = False) -> None:
+        bm = self.burn_monitor
+        if bm is not None:
+            bm.record(tenant, latency_s, shed=shed)
+
     def _evict_locked(self, req, cfg, *, reason: str, where: str) -> None:
         self._counters[cfg.name][f"evict_{reason}"] += 1
         om.emit("serve", event="gw_evict", tenant=cfg.name, op=req.kind,
                 reason=reason, where=where)
+        tlm.counter("gw_evict", tenant=cfg.name, reason=reason).inc()
         if not req.future.done():
             if reason == "deadline":
                 # dlaf: ignore[DLAF004] eviction sheds never left the gateway:
@@ -321,6 +340,13 @@ class Gateway:
         ospans.finish_request(req.trace, outcome=outcome)
         om.emit("serve", event="gw_done", tenant=cfg.name, op=req.kind,
                 outcome=outcome, latency_s=lat)
+        ok = outcome == "ok"
+        tlm.counter("gw_done", tenant=cfg.name,
+                    outcome="ok" if ok else "err").inc()
+        tlm.histogram("gw_latency_s", tenant=cfg.name).observe(lat)
+        # a completed request burns budget when slow; a failed one (shed
+        # mid-pipeline, deadline, device) always does
+        self._record_burn(cfg.name, lat if ok else None, shed=not ok)
 
     # ----------------------------------------------------------- dispatcher
 

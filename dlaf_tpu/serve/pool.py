@@ -48,6 +48,8 @@ from dlaf_tpu.health import (
 )
 from dlaf_tpu.obs import metrics as om
 from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import telemetry as tlm
+from dlaf_tpu.plan import autotune as plan_autotune
 from dlaf_tpu.serve import batched, bucketing
 
 KINDS = ("potrf", "posv", "eigh")
@@ -397,8 +399,20 @@ class SolverPool:
         # requests still get the compile grace instead of being shed
         self._warm.add(key)
         elapsed = time.monotonic() - t0
+        # batch events carry the resolved launch choice (nb, shard mode)
+        # alongside geometry so the service-time harvester can roll them
+        # into a plan.profile entry without re-deriving the decision
+        dtype_str = key[3]
+        nb = (int(self.block_size) if self.block_size is not None
+              else plan_autotune.block_size(kind, bucket, dtype_str))
+        sb = (bool(self.shard_batch) if self.shard_batch is not None
+              else plan_autotune.shard_batch(kind, bucket, dtype_str))
         om.emit("serve", event="batch", op=kind, bucket=str(bucket),
-                batch=len(reqs), seconds=elapsed)
+                batch=len(reqs), seconds=elapsed, dtype=dtype_str,
+                n=int(bucket), nb=nb, shard_batch=sb)
+        tlm.counter("pool_batches", op=kind).inc()
+        tlm.counter("pool_items", op=kind).inc(len(reqs))
+        tlm.histogram("pool_batch_s", op=kind).observe(elapsed)
         done = []
         for i, r in enumerate(reqs):
             queue_s = t0 - r.t_submit

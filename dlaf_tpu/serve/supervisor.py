@@ -46,10 +46,13 @@ import signal as _signal
 import socket
 import threading
 import time
+from collections import deque
 
 from dlaf_tpu.health import DeviceUnresponsiveError, WireProtocolError
 from dlaf_tpu.obs import flight as oflight
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import telemetry as tlm
 from dlaf_tpu.serve import wire
 from dlaf_tpu.serve.pool import ServeResult
 
@@ -116,11 +119,14 @@ class WorkerHandle:
         self.ready_info: dict = {}
         self.served = 0             # results delivered to client futures
         self.outstanding: dict = {}
+        self.rtts: deque = deque(maxlen=256)  # heartbeat round-trip seconds
+        self.last_telemetry: dict | None = None  # latest ack-carried snapshot
         self._lock = threading.Lock()
         self._send_lock = threading.Lock()
         self._seq = 0
         self._hb_seq = 0
         self._acks: dict = {}       # hb seq -> (Event, slot dict)
+        self._hb_sent: dict = {}    # hb seq -> send monotonic (RTT clock)
         self._drains: dict = {}     # ckpt path -> (Event, slot dict)
         self._drain_seq = 0
 
@@ -192,7 +198,21 @@ class WorkerHandle:
 
     # ------------------------------------------------------ frame handlers
 
+    def _reemit_spans(self, msg: dict) -> None:
+        """Fold worker-side span records streamed in a frame into the
+        parent stream, stamped with this worker's process-row identity
+        (``<name>-g<gen>``).  The same spans also live in the worker's own
+        JSONL; export dedupes on span_id, first (this) occurrence wins."""
+        spans = msg.get("spans")
+        if not spans:
+            return
+        wid = f"{self.name}-g{self.gen}"
+        for rec in spans:
+            if isinstance(rec, dict) and "trace_id" in rec:
+                om.emit("span", **{**rec, "worker": wid})
+
     def _on_result(self, msg: dict, arrays: dict) -> None:
+        self._reemit_spans(msg)
         with self._lock:
             req = self.outstanding.pop(msg.get("id"), None)
         if req is None:
@@ -210,6 +230,7 @@ class WorkerHandle:
                 pass
 
     def _on_error(self, msg: dict) -> None:
+        self._reemit_spans(msg)
         with self._lock:
             req = self.outstanding.pop(msg.get("id"), None)
         if req is None:
@@ -223,15 +244,25 @@ class WorkerHandle:
                 pass
 
     def _on_ack(self, msg: dict) -> None:
-        self.last_ack = time.monotonic()
+        now = time.monotonic()
+        self.last_ack = now
         with self._lock:
             pair = self._acks.pop(msg.get("seq"), None)
+            t_sent = self._hb_sent.pop(msg.get("seq"), None)
+        if t_sent is not None:
+            rtt = now - t_sent
+            self.rtts.append(rtt)
+            tlm.histogram("fleet_hb_rtt_s", worker=self.name).observe(rtt)
+        snap = msg.get("telemetry")
+        if isinstance(snap, dict):
+            self.last_telemetry = snap
         if pair is not None:
             evt, slot = pair
             slot.update(msg)
             evt.set()
 
     def _on_drained(self, msg: dict) -> None:
+        self._reemit_spans(msg)
         with self._lock:
             pair = self._drains.get(msg.get("ckpt"))
         if pair is not None:
@@ -254,6 +285,7 @@ class WorkerHandle:
             seq = self._hb_seq
             evt, slot = threading.Event(), {}
             self._acks[seq] = (evt, slot)
+            self._hb_sent[seq] = time.monotonic()
         try:
             self._send({"op": "heartbeat", "seq": seq, "probe": bool(probe),
                         "budget_s": budget_s})
@@ -261,6 +293,7 @@ class WorkerHandle:
         finally:
             with self._lock:
                 self._acks.pop(seq, None)
+                self._hb_sent.pop(seq, None)
         if "ok" not in slot:
             raise DeviceUnresponsiveError(
                 float(timeout), device=self.name,
@@ -268,6 +301,11 @@ class WorkerHandle:
                          f"{seq} within {timeout:g} s"),
             )
         return slot
+
+    def rtt_p95_s(self) -> float | None:
+        """p95 heartbeat round-trip over the recent window (None before
+        the first measured ack)."""
+        return tlm.pct_sorted(sorted(self.rtts), 0.95)
 
     # -------------------------------------------------------- pool protocol
 
@@ -294,6 +332,20 @@ class WorkerHandle:
                    "uplo": req.uplo, "squeeze": bool(req.squeeze),
                    "deadline_rem_s": req.remaining(),
                    "age_s": max(now - req.t_submit, 0.0)}
+            trace = getattr(req, "trace", None)
+            if trace is not None:
+                # propagate the gateway trace across the process hop: the
+                # worker parents its pool.queue/serve.solve spans directly
+                # under the gateway root span (parent_id)
+                msg["trace_id"] = trace["trace_id"]
+                msg["parent_id"] = trace["span_id"]
+                # parent-side wire hop: everything since the last mark
+                # (router pick, queueing) up to the frame leaving.  The
+                # attr is `replica`, not `worker` — a `worker` attr would
+                # move this parent-side span onto the worker's process row
+                # in the Perfetto export
+                req.t_mark = ospans.mark_phase(
+                    trace, "wire.submit", req.t_mark, replica=self.name)
             arrays = {"a": req.a}
             if req.b is not None:
                 arrays["b"] = req.b
@@ -702,6 +754,23 @@ class Supervisor:
                     gen=handle.gen, count=len(copied), paths=copied)
         return copied
 
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Per-worker supervision view: generation, liveness, serve counts
+        and the heartbeat RTT percentiles the telemetry plane surfaces."""
+        out = {}
+        for h in self.handles():
+            rtts = sorted(h.rtts)
+            out[h.name] = {
+                "gen": h.gen, "alive": h.alive, "pending": h.pending(),
+                "served": h.served, "failures": h.failures,
+                "circuit_open": h.circuit_open,
+                "hb_rtt_p50_s": tlm.pct_sorted(rtts, 0.50),
+                "hb_rtt_p95_s": tlm.pct_sorted(rtts, 0.95),
+            }
+        return out
+
     # ------------------------------------------------------------ lifecycle
 
     def close(self) -> None:
@@ -738,7 +807,8 @@ class Autoscaler:
                  up_p95_s: float | None = None, up_queue: int | None = None,
                  down_queue: int | None = None,
                  up_cooldown_s: float | None = None,
-                 down_cooldown_s: float | None = None):
+                 down_cooldown_s: float | None = None,
+                 burn_fn=None):
         from dlaf_tpu.tune import get_tune_parameters
 
         p = get_tune_parameters()
@@ -760,6 +830,12 @@ class Autoscaler:
         self.down_cooldown_s = float(
             down_cooldown_s if down_cooldown_s is not None
             else p.serve_fleet_scale_down_cooldown_s)
+        # third signal: the SLO burn-rate monitor's latched verdict
+        # (obs.telemetry.SloBurnMonitor.hot) — a truthy burn_fn() counts
+        # the step as hot even when queue depth alone looks healthy, so a
+        # fleet burning error budget on latency scales out before the
+        # queue backs up
+        self.burn_fn = burn_fn
         self._up_streak = 0
         self._down_streak = 0
         self._last_up = -1e18
@@ -777,7 +853,8 @@ class Autoscaler:
         # overload ratchets them up permanently — without the queue guard
         # a drained fleet would read as hot forever (scale-down would
         # never fire, and an idle fleet would grow to max on stale p95)
-        hot = queued >= self.up_queue or (
+        burn = bool(self.burn_fn()) if self.burn_fn is not None else False
+        hot = burn or queued >= self.up_queue or (
             p95 > self.up_p95_s and queued > self.down_queue)
         cold = (not hot) and queued <= self.down_queue
         self._up_streak = self._up_streak + 1 if hot else 0
@@ -799,8 +876,8 @@ class Autoscaler:
         if decision is None:
             return None
         self.actions.append({"t": now, "action": decision, "p95_s": p95,
-                             "queued": queued, "workers": n})
+                             "queued": queued, "workers": n, "burn": burn})
         om.emit("fleet", event=decision, p95_s=p95, queued=queued,
-                workers=n, sustain=self.sustain)
+                workers=n, sustain=self.sustain, burn=burn)
         (self.scale_up if decision == "scale_up" else self.scale_down)()
         return decision

@@ -54,6 +54,7 @@ from dlaf_tpu.health import (
     TenantQuotaExceededError,
     WireProtocolError,
 )
+from dlaf_tpu.obs import telemetry as tlm
 
 MAGIC = b"DWF1"
 _PREFIX = struct.Struct(">II")
@@ -104,6 +105,9 @@ def encode_frame(msg: dict, arrays: dict | None = None,
             "oversize",
             f"frame of {total} bytes exceeds the {limit}-byte bound "
             f"(tune.serve_fleet_max_frame_mb)")
+    op = str(msg.get("op", "?")) if isinstance(msg, dict) else "?"
+    tlm.counter("wire_frames_tx", op=op).inc()
+    tlm.counter("wire_bytes_tx").inc(total)
     return b"".join([MAGIC, _PREFIX.pack(len(header), offset), header] + chunks)
 
 
@@ -131,6 +135,9 @@ def _decode_parts(header: bytes, payload: bytes) -> tuple:
             raise WireProtocolError(
                 "array", f"bad array descriptor {d!r}: {exc}") from exc
         arrays[str(d["name"])] = arr.copy()  # writable, payload released
+    op = str(msg.get("op", "?")) if isinstance(msg, dict) else "?"
+    tlm.counter("wire_frames_rx", op=op).inc()
+    tlm.counter("wire_bytes_rx").inc(PREFIX_LEN + len(header) + len(payload))
     return msg, arrays
 
 

@@ -40,6 +40,8 @@ import time
 
 from dlaf_tpu.obs import flight
 from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import telemetry as tlm
 from dlaf_tpu.serve import wire
 
 _WARM_ZERO = {"plans": 0, "compiles": 0, "aot_loads": 0, "seconds": 0.0}
@@ -179,30 +181,82 @@ def _run_real(conn: _Conn, name: str, *, buckets, block_size, max_batch,
     inflight: dict = {}  # wire id -> _Request (undispatched OR dispatched)
     inflight_lock = threading.Lock()
 
-    def _done_cb(rid):
+    # Span streaming: buffer this process's span records per trace_id so
+    # each result/error frame carries its request's worker-side spans back
+    # to the supervisor (which re-emits them into the parent stream stamped
+    # with this worker's identity).  Spans for requests that never resolve
+    # a frame — killed worker — still reach the parent via the worker's
+    # own JSONL, folded in at fleet close; export dedupes on span_id.
+    # Both axes are bounded so a leaked trace cannot grow the buffer.
+    span_buf: dict = {}  # trace_id -> [span record fields]
+    span_lock = threading.Lock()
+    max_traces, max_spans = 512, 64
+
+    def _span_tap(kind, fields):
+        if kind != "span":
+            return
+        tid = fields.get("trace_id")
+        if tid is None:
+            return
+        with span_lock:
+            buf = span_buf.get(tid)
+            if buf is None:
+                if len(span_buf) >= max_traces:
+                    return
+                buf = span_buf[tid] = []
+            if len(buf) < max_spans:
+                buf.append(dict(fields))
+
+    om.add_tap(_span_tap)
+
+    def _pop_spans(trace_id):
+        if trace_id is None:
+            return None
+        with span_lock:
+            return span_buf.pop(trace_id, None)
+
+    def _done_cb(rid, trace_id=None):
         def cb(fut):
             with inflight_lock:
                 if inflight.pop(rid, None) is None:
                     return  # drained to a checkpoint: the supervisor owns it
+            spans_out = _pop_spans(trace_id)
             try:
                 if fut.cancelled():
                     conn.send({"op": "error", "id": rid,
                                **wire.error_fields(wire.DistributionError(
                                    "serve: pool closed under this request"))})
                 elif fut.exception() is not None:
-                    conn.send({"op": "error", "id": rid,
-                               **wire.error_fields(fut.exception())})
+                    msg_out = {"op": "error", "id": rid,
+                               **wire.error_fields(fut.exception())}
+                    if spans_out:
+                        msg_out["spans"] = spans_out
+                    conn.send(msg_out)
                 else:
                     res = fut.result()
                     arrays = {k: v for k, v in
                               (("x", res.x), ("w", res.w), ("v", res.v))
                               if v is not None}
-                    conn.send({"op": "result", "id": rid, "kind": res.kind,
-                               "info": res.info, "queue_s": res.queue_s},
-                              arrays)
+                    msg_out = {"op": "result", "id": rid, "kind": res.kind,
+                               "info": res.info, "queue_s": res.queue_s}
+                    if spans_out:
+                        msg_out["spans"] = spans_out
+                    conn.send(msg_out, arrays)
             except OSError:
                 pass  # supervisor gone; the recv loop will see EOF and exit
         return cb
+
+    def _sample_device_memory():
+        """Per-device bytes-in-use gauges (backends without memory_stats —
+        CPU — simply contribute nothing)."""
+        try:
+            for i, d in enumerate(jax.local_devices()):
+                stats = d.memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    tlm.gauge("worker_device_bytes", device=str(i)).set(
+                        float(stats["bytes_in_use"]))
+        except Exception:  # noqa: BLE001 - telemetry must not hurt liveness
+            pass
 
     while True:
         frame = conn.recv()
@@ -224,10 +278,29 @@ def _run_real(conn: _Conn, name: str, *, buckets, block_size, max_batch,
             req.squeeze = bool(msg.get("squeeze", req.squeeze))
             # keep queue-latency accounting cumulative across the hop: time
             # already spent queued parent-side is queue time, not service
-            req.t_submit -= float(msg.get("age_s", 0.0))
+            age_s = float(msg.get("age_s", 0.0))
+            req.t_submit -= age_s
+            trace_id = msg.get("trace_id")
+            if trace_id:
+                # Inherit the gateway's trace across the process hop: a
+                # synthetic handle whose span_id IS the parent-side root
+                # span id, so the pool's pool.queue / serve.solve children
+                # attach directly under the gateway root in the merged
+                # timeline.  t0_s/m0 are back-dated by the wire age so
+                # phase wall-times line up with the parent's clock.
+                ospans.enable()
+                req.trace = {
+                    "name": "wire.request", "trace_id": str(trace_id),
+                    "span_id": str(msg.get("parent_id") or trace_id),
+                    "parent_id": None,
+                    "t0_s": time.time() - age_s,
+                    "m0": time.monotonic() - age_s,
+                    "attrs": {},
+                }
+                req.t_mark = time.monotonic()
             with inflight_lock:
                 inflight[rid] = req
-            req.future.add_done_callback(_done_cb(rid))
+            req.future.add_done_callback(_done_cb(rid, trace_id))
             overflow = pool.adopt([req])
             if overflow:
                 with inflight_lock:
@@ -242,8 +315,15 @@ def _run_real(conn: _Conn, name: str, *, buckets, block_size, max_batch,
                     probe_s = watchdog.probe(msg.get("budget_s"))
                 except Exception:  # noqa: BLE001 - the probe verdict
                     ok = False
-            conn.send({"op": "heartbeat_ack", "seq": msg.get("seq"), "ok": ok,
-                       "pending": pool.pending(), "probe_s": float(probe_s)})
+            ack = {"op": "heartbeat_ack", "seq": msg.get("seq"), "ok": ok,
+                   "pending": pool.pending(), "probe_s": float(probe_s)}
+            if tlm.enabled():
+                # piggyback the live instrument snapshot on the ack — the
+                # supervisor merges it into the fleet view, no extra frames
+                tlm.gauge("worker_pending").set(pool.pending())
+                _sample_device_memory()
+                ack["telemetry"] = tlm.snapshot()
+            conn.send(ack)
         elif op == "drain":
             reqs = pool.drain()
             entries = []
@@ -262,9 +342,16 @@ def _run_real(conn: _Conn, name: str, *, buckets, block_size, max_batch,
                         "age_s": now - r.t_submit, "a": r.a, "b": r.b,
                     })
             wire.save_request_checkpoint(msg["ckpt"], entries)
-            conn.send({"op": "drained", "count": len(entries),
-                       "ids": [e["id"] for e in entries],
-                       "ckpt": msg["ckpt"]})
+            # flush every buffered span with the drain answer: the traces
+            # leaving on the checkpoint will never see a result frame here
+            with span_lock:
+                leftovers = [r for recs in span_buf.values() for r in recs]
+                span_buf.clear()
+            out = {"op": "drained", "count": len(entries),
+                   "ids": [e["id"] for e in entries], "ckpt": msg["ckpt"]}
+            if leftovers:
+                out["spans"] = leftovers
+            conn.send(out)
         elif op == "shutdown":
             pool.close()
             conn.send({"op": "bye"})

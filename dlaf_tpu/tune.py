@@ -244,6 +244,22 @@ class TuneParameters:
     - ``serve_fleet_max_frame_mb``: wire-frame size bound for the fleet
       transports (``serve.wire``) — a forged length prefix must not make
       a reader allocate gigabytes.
+    - ``telemetry``: master switch for the live instrument registry
+      (``obs.telemetry``) — counters/gauges/histograms at the gateway,
+      pool, wire codec, supervisor and workers.  Off (default), every
+      instrument accessor returns a shared no-op after one flag test.
+    - ``telemetry_harvest_min_samples``: completed batches a geometry
+      needs before the service-time harvester includes it in the
+      persisted plan profile (fewer = noise steering the autotuner).
+    - ``slo_burn_target_p95_s``: per-request latency above this counts
+      against the tenant's error budget in the SLO burn-rate monitor
+      (sheds always count).
+    - ``slo_burn_budget``: allowed bad-request fraction (error budget);
+      burn rate = windowed bad fraction / budget.
+    - ``slo_burn_fast_s`` / ``slo_burn_slow_s``: the dual sliding
+      windows — a tenant fires only when BOTH windows burn at or above
+      ``slo_burn_threshold`` (fast catches the spike, slow stops a blip
+      from paging).
     - ``debug_dump_eigensolver_data``: dump per-stage matrices to .npz
       (reference debug_dump_* flags, tune.h:30-67).
     """
@@ -337,6 +353,25 @@ class TuneParameters:
     serve_fleet_max_frame_mb: float = field(
         default_factory=lambda: _env("serve_fleet_max_frame_mb", 64.0, float)
     )
+    telemetry: bool = field(default_factory=lambda: _env("telemetry", False, bool))
+    telemetry_harvest_min_samples: int = field(
+        default_factory=lambda: _env("telemetry_harvest_min_samples", 8, int)
+    )
+    slo_burn_target_p95_s: float = field(
+        default_factory=lambda: _env("slo_burn_target_p95_s", 2.0, float)
+    )
+    slo_burn_budget: float = field(
+        default_factory=lambda: _env("slo_burn_budget", 0.05, float)
+    )
+    slo_burn_fast_s: float = field(
+        default_factory=lambda: _env("slo_burn_fast_s", 60.0, float)
+    )
+    slo_burn_slow_s: float = field(
+        default_factory=lambda: _env("slo_burn_slow_s", 600.0, float)
+    )
+    slo_burn_threshold: float = field(
+        default_factory=lambda: _env("slo_burn_threshold", 2.0, float)
+    )
     panel_trsm_pallas: bool = field(default_factory=lambda: _env("panel_trsm_pallas", False, bool))
     dc_secular_pallas: bool = field(default_factory=lambda: _env("dc_secular_pallas", False, bool))
     debug_dump_eigensolver_data: bool = field(
@@ -360,6 +395,8 @@ class TuneParameters:
                 validate_matmul_precision(v, knob=k)
             elif k.startswith("serve_fleet_"):
                 validate_serve_fleet_knob(k, v)
+            elif k.startswith("slo_burn_") or k == "telemetry_harvest_min_samples":
+                validate_telemetry_knob(k, v)
             setattr(self, k, v)
         return self
 
@@ -487,6 +524,36 @@ def validate_serve_fleet_knob(knob: str, value) -> None:
             f"got {value!r} (env DLAF_TPU_{knob.upper()})")
 
 
+def validate_telemetry_knob(knob: str, value) -> None:
+    """Fail-fast domain check for the telemetry-plane knobs: every one is
+    a positive number; ``slo_burn_budget`` must additionally be <= 1 (it
+    is a fraction of traffic) and ``telemetry_harvest_min_samples`` an
+    integer >= 1.  Same shape as :func:`validate_serve_fleet_knob` — a
+    typo'd ``DLAF_TPU_SLO_BURN_*`` / ``DLAF_TPU_TELEMETRY_*`` env value
+    surfaces as a ConfigurationError, not a silent monitor."""
+    from dlaf_tpu.health import ConfigurationError
+
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        raise ConfigurationError(
+            f"{knob} must be numeric, got {value!r} "
+            f"(env DLAF_TPU_{knob.upper()})") from None
+    if knob == "telemetry_harvest_min_samples":
+        ok = v >= 1 and float(v).is_integer()
+        domain = "an integer >= 1"
+    elif knob == "slo_burn_budget":
+        ok = 0 < v <= 1
+        domain = "a fraction in (0, 1]"
+    else:
+        ok = v > 0
+        domain = "> 0"
+    if not ok:
+        raise ConfigurationError(
+            f"{knob} must be {domain}, got {value!r} "
+            f"(env DLAF_TPU_{knob.upper()})")
+
+
 def validate_collectives_impl(value) -> str:
     """Reject values outside the documented domain with a structured error.
 
@@ -532,6 +599,12 @@ def initialize(**overrides) -> TuneParameters:
     from dlaf_tpu.plan import autotune
 
     autotune.load_profile()
+    from dlaf_tpu.obs import telemetry
+
+    if p.telemetry:
+        telemetry.enable()
+    else:
+        telemetry.disable()
     return p
 
 

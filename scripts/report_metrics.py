@@ -9,6 +9,12 @@ the per-stage breakdown, the per-collective message/byte accounting, and
 jit compile totals with persistent-cache hit/miss counts.  Every record is
 schema-validated on read (obs.metrics.validate_record), so a malformed or
 foreign file fails loudly instead of summarizing garbage.
+
+``dlaf_tpu.obs/6`` streams additionally carry the fleet telemetry plane:
+``telemetry`` records (the merged counter/gauge/histogram snapshot the
+fleet emits at close) render as a roll-up table, ``slo_burn`` events as
+the per-tenant burn-rate story, and the service-time harvest (``plan``
+``harvest`` / ``profile_loaded`` events) as one line each.
 """
 from __future__ import annotations
 
@@ -485,6 +491,54 @@ def summarize(path: str) -> int:
                 src[r.get("source", "?")] += 1
             print(f"   autotune decisions: {len(decs)} ("
                   + ", ".join(f"{s} x{n}" for s, n in sorted(src.items())) + ")")
+        # service-time harvest: fleet telemetry rolled into a reusable
+        # plan profile, and profiles loaded back into the autotuner
+        for r in plan:
+            if r["event"] == "harvest":
+                print(f"   harvest: {r.get('entries', '?')} profile entries "
+                      f"from {r.get('geometries_seen', '?')} geometries "
+                      f"-> {r.get('path', '?')}")
+            elif r["event"] == "profile_loaded":
+                print(f"   profile loaded: {r.get('entries', '?')} entries "
+                      f"from {r.get('path', '?')}"
+                      + ("  [harvested]" if r.get("harvested") else ""))
+
+    tel = by_kind.get("telemetry", [])
+    if tel:
+        from dlaf_tpu.obs import telemetry as tlm
+
+        # the LAST snapshot is the authoritative one (the fleet emits its
+        # merged parent+worker view once at close)
+        snap = tel[-1].get("snapshot", {})
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        hists = snap.get("hists", {})
+        print(f"-- telemetry ({len(tel)} snapshot(s), scope "
+              f"{tel[-1].get('scope', '?')}): {len(counters)} counters, "
+              f"{len(gauges)} gauges, {len(hists)} histograms")
+        for k, v in sorted(counters.items()):
+            print(f"   {k:44s} {v:>12g}")
+        for k, v in sorted(gauges.items()):
+            print(f"   {k:44s} {v:>12g}")
+        for k, h in sorted(hists.items()):
+            cnt = int(h.get("count", 0))
+            p50 = tlm.percentile(h, 0.50)
+            p95 = tlm.percentile(h, 0.95)
+            print(f"   {k:44s} n={cnt:<8d} p50<={p50:g} p95<={p95:g}")
+
+    burns = by_kind.get("slo_burn", [])
+    if burns:
+        per_tenant = defaultdict(lambda: [0, 0])  # firings, clears
+        for r in burns:
+            per_tenant[r.get("tenant", "?")][0 if r.get("firing") else 1] += 1
+        print(f"-- slo burn ({len(burns)} transitions):")
+        for t, (fired, cleared) in sorted(per_tenant.items()):
+            print(f"   {t:>12s} fired {fired}x, cleared {cleared}x")
+        last = burns[-1]
+        print(f"   last: tenant {last.get('tenant', '?')} "
+              f"fast {last.get('fast_burn', 0.0):.1f}x / "
+              f"slow {last.get('slow_burn', 0.0):.1f}x "
+              f"{'FIRING' if last.get('firing') else 'cleared'}")
 
     for r in by_kind.get("scenario", []):
         if r["event"] == "result":
@@ -500,6 +554,10 @@ def summarize(path: str) -> int:
                 print(f"   outcomes: {outcome}")
             for f in r.get("failures", []):
                 print(f"   SLO FAIL: {f}")
+        elif r["event"] == "trace_chains":
+            print(f"-- trace chains ({'fleet' if r.get('fleet') else 'local'}): "
+                  f"{r.get('full', 0)}/{r.get('roots', 0)} complete "
+                  f"({100 * r.get('frac', 0.0):.0f}%) over {r.get('need', [])}")
         elif r["event"] == "replay":
             print(f"-- replay of {r.get('source', '?')} "
                   f"(scenario {r.get('scenario', '?')!r}): "

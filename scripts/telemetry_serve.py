@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Telemetry scrape endpoint / renderer over the live-metrics registry.
+
+Two modes over the same plain-text (Prometheus-style) rendering from
+``dlaf_tpu.obs.telemetry``:
+
+* **render** (default) — read a metrics JSONL, take the LAST ``telemetry``
+  record's snapshot (the fleet emits its merged view at close) and print
+  the scrape text, or write it with ``--out``.  This is what CI uploads
+  next to the merged Perfetto trace: the fleet's final counter/gauge/
+  histogram state as one greppable artifact.
+
+      python scripts/telemetry_serve.py fleet.jsonl --out scrape.txt
+
+* **serve** — with ``--port``, expose the snapshot over HTTP at ``/``
+  and ``/metrics`` until interrupted.  With a JSONL input the file is
+  re-read per scrape (tail a growing run); without one, the scrape shows
+  THIS process's registry (mostly useful under ``--port 0`` smoke tests).
+
+      python scripts/telemetry_serve.py fleet.jsonl --port 9100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable as `python scripts/telemetry_serve.py` from a checkout (the
+# common case) without an install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def last_snapshot(path: str) -> dict | None:
+    """The newest ``telemetry`` record's snapshot in ``path`` (None when
+    the stream has none — e.g. a run with telemetry off)."""
+    from dlaf_tpu.obs import metrics as om
+
+    snap = None
+    for rec in om.read_jsonl(path):
+        if rec.get("kind") == "telemetry" and isinstance(rec.get("snapshot"), dict):
+            snap = rec["snapshot"]
+    return snap
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("metrics", nargs="?", default=None,
+                    help="metrics JSONL holding telemetry records (omit to "
+                         "use this process's live registry)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="serve over HTTP on this port instead of printing "
+                         "(0 = ephemeral)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--out", default=None,
+                    help="write the scrape text here instead of stdout")
+    args = ap.parse_args(argv)
+
+    from dlaf_tpu.obs import telemetry as tlm
+
+    def snapshot_fn() -> dict:
+        if args.metrics:
+            snap = last_snapshot(args.metrics)
+            if snap is None:
+                return {"schema": tlm.SNAPSHOT_SCHEMA, "counters": {},
+                        "gauges": {}, "hists": {}}
+            return snap
+        return tlm.snapshot()
+
+    if args.port is not None:
+        srv = tlm.serve_scrape(args.port, snapshot_fn, host=args.host)
+        host, port = srv.server_address[:2]
+        print(f"telemetry scrape at http://{host}:{port}/metrics (ctrl-C to stop)")
+        try:
+            import time
+
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.shutdown()
+        return 0
+
+    if args.metrics and last_snapshot(args.metrics) is None:
+        print(f"{args.metrics}: no telemetry records "
+              f"(run with DLAF_TPU_TELEMETRY=1)", file=sys.stderr)
+        return 1
+    text = tlm.render_text(snapshot_fn())
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text)
+        print(f"wrote {args.out} ({len(text.splitlines())} lines)")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,337 @@
+"""Fleet telemetry plane — registry, burn rate, harvest, trace chains.
+
+Bottom-up over ``dlaf_tpu.obs.telemetry``: the instrument registry is a
+shared no-op while disabled (hot paths pay one branch, nothing
+registers); enabled, counters/gauges/histograms snapshot to JSON-safe
+dicts whose merge adds counters and bucket counts (the fleet view);
+bucket percentiles are nearest-rank over the upper bounds; the
+Prometheus-style rendering carries cumulative buckets plus derived
+percentile lines; the scrape endpoint serves it over HTTP.  The SLO
+burn-rate monitor is exercised as a pure decision function on an
+injected clock (fires only when BOTH windows burn, clears when the fast
+window drains, transitions emit ``slo_burn`` records).  The service-time
+harvester rolls batch observations into a ``dlaf_tpu.plan.profile/1``
+document that flips ``plan/autotune.decide`` to ``source='profile'``.
+And ONE real two-process fleet run proves the acceptance core: >= 95% of
+completed requests carry the full cross-process span chain (gateway root
+-> wire hop -> worker solve) under a single trace id in the merged
+stream, worker telemetry merges into the fleet snapshot, and the run's
+service times harvest into a loadable profile.
+"""
+import asyncio
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dlaf_tpu import serve, tune
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.obs import spans as ospans
+from dlaf_tpu.obs import telemetry as tlm
+from dlaf_tpu.plan import autotune
+from dlaf_tpu.testing import random_hermitian_pd
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Telemetry state is process-global: leave it off and empty."""
+    tlm.reset()
+    yield
+    tlm.reset()
+    tlm.disable()
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_off_hands_out_one_shared_noop():
+    assert not tlm.enabled()
+    c = tlm.counter("gw_admitted", tenant="t0")
+    g = tlm.gauge("worker_pending")
+    h = tlm.histogram("gw_latency_s")
+    # one shared object, not per-call garbage
+    assert c is g is h is tlm.counter("anything_else", x="y")
+    c.inc()
+    g.set(3.0)
+    h.observe(0.5)
+    snap = tlm.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {} and snap["hists"] == {}
+
+
+def test_registry_counters_gauges_histograms_snapshot():
+    tlm.enable()
+    tlm.counter("req_total", tenant="a").inc()
+    tlm.counter("req_total", tenant="a").inc(2)
+    tlm.counter("req_total", tenant="b").inc()
+    tlm.gauge("pending").set(7)
+    hist = tlm.histogram("lat_s", bounds=(0.1, 1.0), op="potrf")
+    for v in (0.05, 0.5, 5.0):
+        hist.observe(v)
+    snap = tlm.snapshot()
+    assert snap["schema"] == tlm.SNAPSHOT_SCHEMA
+    assert snap["counters"]["req_total{tenant=a}"] == 3
+    assert snap["counters"]["req_total{tenant=b}"] == 1
+    assert snap["gauges"]["pending"] == 7
+    h = snap["hists"]["lat_s{op=potrf}"]
+    assert h["buckets"] == [1, 1, 1]  # one per bucket incl. +inf
+    assert h["count"] == 3 and h["min"] == 0.05 and h["max"] == 5.0
+
+
+def test_merge_adds_counters_and_buckets_gauges_last_wins():
+    tlm.enable()
+    tlm.counter("n").inc(2)
+    tlm.gauge("g").set(1)
+    tlm.histogram("h", bounds=(1.0,)).observe(0.5)
+    snap = tlm.snapshot()
+    other = json.loads(json.dumps(snap))  # wire round-trip
+    other["gauges"]["g"] = 9
+    merged = tlm.merge(snap, other)
+    assert merged["counters"]["n"] == 4
+    assert merged["gauges"]["g"] == 9  # last (freshest) writer wins
+    assert merged["hists"]["h"]["buckets"] == [2, 0]
+    assert merged["hists"]["h"]["count"] == 2
+
+
+def test_percentile_is_nearest_rank_over_bucket_bounds():
+    tlm.enable()
+    h = tlm.histogram("p", bounds=(1.0, 2.0, 3.0))
+    for v in (0.5, 1.5, 2.5):
+        h.observe(v)
+    snap = tlm.snapshot()["hists"]["p"]
+    assert tlm.percentile(snap, 0.50) == 2.0  # 2nd of 3 -> bound 2.0
+    assert tlm.percentile(snap, 1.00) == 3.0
+    assert tlm.percentile({"count": 0, "bounds": [], "buckets": []}, 0.5) is None
+    # tail bucket reports the observed max, not a fake bound
+    h.observe(99.0)
+    snap = tlm.snapshot()["hists"]["p"]
+    assert tlm.percentile(snap, 1.00) == 99.0
+
+
+def test_render_text_is_prometheus_shaped():
+    tlm.enable()
+    tlm.counter("req_total", tenant="a").inc(3)
+    tlm.histogram("lat_s", bounds=(0.1, 1.0)).observe(0.05)
+    text = tlm.render_text()
+    assert "req_total{tenant=a} 3" in text
+    assert "lat_s_bucket{le=0.1} 1" in text
+    assert "lat_s_bucket{le=+Inf} 1" in text
+    assert "lat_s_count 1" in text
+    assert "lat_s_p95 0.1" in text
+
+
+def test_tune_initialize_gates_the_registry(monkeypatch):
+    monkeypatch.setenv("DLAF_TPU_TELEMETRY", "1")
+    tune.initialize()
+    assert tlm.enabled()
+    monkeypatch.delenv("DLAF_TPU_TELEMETRY")
+    tune.initialize()
+    assert not tlm.enabled()
+
+
+def test_scrape_endpoint_serves_the_registry():
+    tlm.enable()
+    tlm.counter("scrape_total", job="t").inc(3)
+    srv = tlm.serve_scrape(0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "scrape_total{job=t} 3" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------ burn-rate monitor
+
+
+def test_burn_monitor_fires_on_dual_window_and_clears(tmp_path):
+    now = [0.0]
+    mon = tlm.SloBurnMonitor(p95_target_s=0.1, budget=0.1, fast_s=60.0,
+                             slow_s=600.0, threshold=2.0, clock=lambda: now[0])
+    om.enable(str(tmp_path / "burn.jsonl"))
+    try:
+        for _ in range(10):
+            mon.record("a", 0.01)
+        st = mon.check()["a"]
+        assert not st["firing"] and not mon.hot()
+        # burst of sheds: bad fraction 0.5 over a 0.1 budget = 5x burn in
+        # BOTH windows -> firing
+        for _ in range(10):
+            mon.record("a", shed=True)
+        st = mon.check()["a"]
+        assert st["firing"] and mon.hot()
+        assert st["fast_burn"] >= 2.0 and st["slow_burn"] >= 2.0
+        assert st["shed_frac"] == pytest.approx(0.5)
+        # the fast window drains past the burst under good traffic ->
+        # clears even though the slow window still remembers the sheds
+        now[0] = 120.0
+        for _ in range(50):
+            mon.record("a", 0.01)
+        st = mon.check()["a"]
+        assert not st["firing"] and not mon.hot()
+        assert st["fast_burn"] == 0.0 and st["slow_burn"] > 0.0
+    finally:
+        om.close()
+    burns = [r for r in om.read_jsonl(str(tmp_path / "burn.jsonl"))
+             if r["kind"] == "slo_burn"]
+    # transitions only: fired once, cleared once — no per-check spam
+    assert [r["firing"] for r in burns] == [True, False]
+    assert all(r["tenant"] == "a" for r in burns)
+
+
+def test_burn_monitor_slow_latency_counts_as_bad():
+    mon = tlm.SloBurnMonitor(p95_target_s=0.1, budget=0.05, threshold=2.0)
+    for _ in range(10):
+        mon.record("lat", 5.0)  # way over target, never shed
+    st = mon.check()["lat"]
+    assert st["firing"] and st["shed_frac"] == 0.0
+
+
+def test_burn_monitor_rejects_nonpositive_budget():
+    with pytest.raises(ValueError):
+        tlm.SloBurnMonitor(p95_target_s=1.0, budget=0.0)
+
+
+# ------------------------------------------------------------ harvesting
+
+
+def test_harvester_profile_flips_autotune_to_measured(tmp_path):
+    h = tlm.ServiceTimeHarvester(min_samples=2)
+    for _ in range(3):
+        h.observe("potrf", 256, "float32", 8, 0.05, nb=64, shard_batch=False)
+    h.observe("posv", 128, "float32", 4, 0.02)  # below min_samples: dropped
+    prof = h.profile()
+    assert [(e["op"], e["n"]) for e in prof["entries"]] == [("potrf", 256)]
+    entry = prof["entries"][0]
+    assert entry["choice"] == {"nb": 64, "shard_batch": False}
+    assert entry["measured"]["batches"] == 3
+    assert entry["measured"]["items"] == 24
+    assert entry["measured"]["mean_batch_s"] == pytest.approx(0.05)
+
+    path = str(tmp_path / "prof.json")
+    assert h.write(path) is not None
+    autotune.load_profile(path)
+    try:
+        d = autotune.decide("potrf", 256, "float32", ndevices=1, backend="cpu")
+        assert d.source == "profile"
+        assert d.nb == 64 and d.shard_batch is False
+        # un-harvested geometry still resolves analytically
+        assert autotune.decide("potrf", 512, "float32", ndevices=1,
+                               backend="cpu").source == "analytic"
+    finally:
+        autotune.clear_profile()
+
+
+def test_harvester_ingest_reads_batch_records_and_skips_foreign():
+    h = tlm.ServiceTimeHarvester(min_samples=1)
+    recs = [
+        {"kind": "serve", "event": "batch", "op": "potrf", "n": 8,
+         "dtype": "<f8", "batch": 4, "seconds": 0.01, "nb": 8,
+         "shard_batch": False},
+        {"kind": "serve", "event": "batch", "op": "potrf", "bucket": "8"},
+        {"kind": "serve", "event": "gw_done", "tenant": "t"},
+        {"kind": "span", "name": "serve.solve"},
+    ]
+    assert h.ingest(recs) == 1  # pre-/6 batch (no geometry) + foreign skipped
+    assert [(e["op"], e["n"], e["dtype"]) for e in h.profile()["entries"]] \
+        == [("potrf", 8, "<f8")]
+
+
+def test_harvester_write_refuses_empty_profile(tmp_path):
+    h = tlm.ServiceTimeHarvester(min_samples=99)
+    h.observe("potrf", 8, "float32", 1, 0.01)
+    path = str(tmp_path / "empty.json")
+    assert h.write(path) is None
+    assert not os.path.exists(path)
+
+
+# ------------------------------------- the real two-process acceptance run
+
+
+def test_fleet_trace_chains_telemetry_and_harvest(tmp_path, monkeypatch):
+    """The acceptance core: a real two-worker fleet serves a request
+    stream with telemetry on; afterwards the MERGED metrics stream shows
+    (a) >= 95% of completed requests carrying the full cross-process span
+    chain — gateway root -> wire.submit -> worker-side pool.queue +
+    serve.solve — under one trace id, (b) worker registry snapshots
+    merged into the fleet telemetry record, and (c) the run's measured
+    service times harvested into a profile that flips
+    ``plan/autotune.decide`` to ``source='profile'``."""
+    n_requests = 16
+    monkeypatch.setenv("DLAF_TPU_TELEMETRY", "1")
+    monkeypatch.setenv("DLAF_TPU_TELEMETRY_HARVEST_MIN_SAMPLES", "1")
+    tune.initialize()
+    assert tlm.enabled()
+    mpath = str(tmp_path / "fleet.jsonl")
+    om.enable(mpath)
+    ospans.enable()
+    fleet = serve.Fleet(
+        [serve.TenantConfig("t", max_pending=64)],
+        workers=2, buckets="8", block_size=8, max_batch=4,
+        warm_ops=("potrf",), base_dir=str(tmp_path),
+        heartbeat_s=0.2, ready_timeout_s=240.0,
+    )
+    try:
+        bank = [random_hermitian_pd(6, np.float64, seed=s) for s in range(4)]
+
+        async def drive():
+            return await asyncio.gather(
+                *(fleet.gateway.submit("t", "potrf", "L",
+                                       bank[i % len(bank)])
+                  for i in range(n_requests)))
+
+        results = asyncio.run(drive())
+        assert all(r.info == 0 for r in results)
+        # a heartbeat round-trip carries each worker's registry snapshot
+        fleet.tick()
+        for h in fleet.supervisor.handles():
+            h.heartbeat()
+        merged = fleet.merged_telemetry()
+        counters = merged["counters"]
+        assert counters.get("gw_admitted{tenant=t}") == n_requests
+        # the pool counters live in the WORKER processes: their presence
+        # in the merge proves snapshots crossed the wire
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("pool_items")) >= n_requests
+        st = fleet.stats()
+        assert "telemetry" in st and "slo_burn" in st
+        for w in st["workers"].values():
+            assert "hb_rtt_p95_s" in w
+    finally:
+        fleet.close()
+        ospans.disable()
+        om.close()
+        tune.initialize()
+
+    recs = om.read_jsonl(mpath)
+    from dlaf_tpu.scenario import runner
+    chains = runner.trace_chain_stats(recs, fleet=True)
+    assert chains["roots"] == n_requests
+    assert chains["frac"] >= 0.95, chains
+    # worker spans landed stamped with their incarnation row
+    stamped = {r["worker"] for r in recs
+               if r["kind"] == "span" and "worker" in r}
+    assert any(w.startswith("replica0-g") for w in stamped)
+    assert any(w.startswith("replica1-g") for w in stamped)
+    # the fleet emitted its merged registry once at close
+    tel = [r for r in recs if r["kind"] == "telemetry"]
+    assert len(tel) == 1 and tel[0]["scope"] == "fleet"
+    assert tel[0]["snapshot"]["counters"]["gw_admitted{tenant=t}"] == n_requests
+    # service times harvested into a loadable profile (bucket n, not
+    # request n: the fleet served n=6 under the 8-bucket)
+    assert fleet.profile_path is not None
+    autotune.load_profile(fleet.profile_path)
+    try:
+        d = autotune.decide("potrf", 8, "float64", ndevices=1, backend="cpu")
+        assert d.source == "profile"
+    finally:
+        autotune.clear_profile()
+    harvests = [r for r in recs
+                if r["kind"] == "plan" and r.get("event") == "harvest"]
+    assert len(harvests) == 1 and harvests[0]["entries"] >= 1
