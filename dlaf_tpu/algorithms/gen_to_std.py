@@ -45,7 +45,7 @@ from jax import lax
 from dlaf_tpu.algorithms import _spmd
 from dlaf_tpu.algorithms.triangular_solver import triangular_solver
 from dlaf_tpu.comm import collectives as coll
-from dlaf_tpu.comm.grid import COL_AXIS
+from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
@@ -70,6 +70,7 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
     myr, myc = coll.my_rank()
     b = _spmd.pad_diag_identity(b, g, myr, myc)  # padded L tiles stay non-singular
     half = 0.5
+    fused_tier = _spmd.trailing_update_trace_key() == "fused"
 
     def step(k, a, L, C):
         kr, kc = k % g.pr, k % g.pc
@@ -93,11 +94,23 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
             pan1 = pan - corr  # the value her2k uses
             mine_c = myc == kc
             cp_a = coll.bcast(
-                jnp.where(below, pan1, jnp.zeros_like(pan1)), kc, COL_AXIS
+                jnp.where(below, pan1, jnp.zeros_like(pan1)), kc, COL_AXIS,
+                consumed=fused_tier,
             )
-            cp_l = coll.bcast(jnp.where(below, xl, jnp.zeros_like(xl)), kc, COL_AXIS)
-            rp_a = coll.transpose_panel_windowed(cp_a, jv, rs, g.mt)
-            rp_l = coll.transpose_panel_windowed(cp_l, jv, rs, g.mt)
+            cp_l = coll.bcast(
+                jnp.where(below, xl, jnp.zeros_like(xl)), kc, COL_AXIS,
+                consumed=fused_tier,
+            )
+            if fused_tier:
+                taken_a, have_a = coll.transpose_panel_windowed_parts(
+                    cp_a, jv, rs, g.mt
+                )
+                taken_l, have_l = coll.transpose_panel_windowed_parts(
+                    cp_l, jv, rs, g.mt
+                )
+            else:
+                rp_a = coll.transpose_panel_windowed(cp_a, jv, rs, g.mt)
+                rp_l = coll.transpose_panel_windowed(cp_l, jv, rs, g.mt)
         # write back the twice-corrected panel and the transformed diag tile
         pan2 = pan1 - corr
         new_col = jnp.where(below & mine_c, pan2, xa)
@@ -108,8 +121,24 @@ def _hegst_phase_a_kernel(a, b, g: _spmd.Geometry):
         # her2k on the trailing window: A -= L_p P^H + P L_p^H
         with _scope("hegst.her2k"):
             xs = lax.dynamic_slice(a, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
-            xs = xs - t.contract("iab,jcb->ijac", cp_l, rp_a.conj())
-            xs = xs - t.contract("iab,jcb->ijac", cp_a, rp_l.conj())
+            if fused_tier:
+                from dlaf_tpu.ops import pallas_trailing_update as ptu
+
+                # two consume rings, one per addend.  Slots at or left of
+                # panel k are suppressed: under the xla tier they carry
+                # exactly-zero exchanged panels (the below-mask zeroed
+                # them at the bcast), and subtracting an exactly-zero
+                # contraction is bitwise identity, so parity holds.
+                suppress = jv <= k
+                xs, _ = ptu.fused_transpose_update(
+                    xs, cp_l, taken_a, have_a, suppress, ROW_AXIS
+                )
+                xs, _ = ptu.fused_transpose_update(
+                    xs, cp_a, taken_l, have_l, suppress, ROW_AXIS
+                )
+            else:
+                xs = xs - t.contract("iab,jcb->ijac", cp_l, rp_a.conj())
+                xs = xs - t.contract("iab,jcb->ijac", cp_a, rp_l.conj())
             return lax.dynamic_update_slice(a, xs, (rs, cs, 0, 0))
 
     for k0, k1 in _spmd.halving_segments(g.mt):

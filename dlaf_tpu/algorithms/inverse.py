@@ -36,6 +36,7 @@ from dlaf_tpu.comm.grid import COL_AXIS, ROW_AXIS
 from dlaf_tpu.matrix import util as mutil
 from dlaf_tpu.matrix.matrix import DistributedMatrix
 from dlaf_tpu.obs.trace import scope as _scope
+from dlaf_tpu.ops import pallas_trailing_update as ptu
 from dlaf_tpu.ops import tile as t
 from dlaf_tpu.plan import core as _plan
 
@@ -86,6 +87,7 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
     x = _spmd.pad_diag_identity(x, g, myr, myc)
     eye = jnp.eye(g.mb, dtype=x.dtype)
     mt = g.mt
+    fused_tier = _spmd.trailing_update_trace_key() == "fused"
 
     def step(s, x, L, C):
         k = mt - 1 - s
@@ -103,13 +105,28 @@ def _trtri_lower_bucketed_kernel(x, g: _spmd.Geometry, diag):
         # original column k below the diagonal, to every rank column
         with _scope("trtri.panel_bcast"):
             xc = lax.dynamic_slice(x, (rs, lkc, 0, 0), (L, 1, g.mb, g.mb))[:, 0]
-            cp = coll.bcast(jnp.where(below, xc, jnp.zeros_like(xc)), kc, COL_AXIS)
-            rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k], j window
+            cp = coll.bcast(
+                jnp.where(below, xc, jnp.zeros_like(xc)), kc, COL_AXIS,
+                consumed=fused_tier,
+            )
+            if fused_tier:
+                taken, have = coll.transpose_panel_windowed_parts(
+                    cp, gj_w, rs, g.mt
+                )
+                rp = ptu.consume_exchange(taken, have, ROW_AXIS)
+            else:
+                rp = coll.transpose_panel_windowed(cp, gj_w, rs, g.mt)  # L[j,k]
         # S[i] = sum_j inv[i,j] L[j,k] over the trailing slab (inv final there)
         with _scope("trtri.update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
             keep = ((gj_w > k)[None, :] & (gi_w[:, None] >= gj_w[None, :]))[:, :, None, None]
-            s_part = t.contract("ijab,jbc->iac", jnp.where(keep, xs, jnp.zeros_like(xs)), rp)
+            xk = jnp.where(keep, xs, jnp.zeros_like(xs))
+            if fused_tier and ptu.update_kernel_ok(xs.dtype):
+                # the contraction sums over j: one-shot in-VMEM kernel, not
+                # per-hop consumption (see panel_contract's docstring)
+                s_part = ptu.panel_contract(xk, rp, "ijab,jbc->iac")
+            else:
+                s_part = t.contract("ijab,jbc->iac", xk, rp)
             s_full = coll.psum_axis(s_part, COL_AXIS)
             newcol = -t.contract("iab,bc->iac", s_full, tkk)
         newcol = jnp.where(below & (myc == kc), newcol, xc)
@@ -138,6 +155,7 @@ def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
     x = _spmd.pad_diag_identity(x, g, myr, myc)
     eye = jnp.eye(g.mb, dtype=x.dtype)
     mt = g.mt
+    fused_tier = _spmd.trailing_update_trace_key() == "fused"
 
     def step(s, x, L, C):
         k = mt - 1 - s
@@ -154,13 +172,26 @@ def _trtri_upper_bucketed_kernel(x, g: _spmd.Geometry, diag):
         # windowed row panel of U[k, cs:cs+C] (covers all trailing cols > k)
         with _scope("trtri.panel_bcast"):
             xr = lax.dynamic_slice(x, (lkr, cs, 0, 0), (1, C, g.mb, g.mb))[0]
-            rp = coll.bcast(jnp.where(right, xr, jnp.zeros_like(xr)), kr, ROW_AXIS)
+            rp = coll.bcast(
+                jnp.where(right, xr, jnp.zeros_like(xr)), kr, ROW_AXIS,
+                consumed=fused_tier,
+            )
             # row panel U[k, v] -> windowed col panel indexed by window rows i
-            cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
+            if fused_tier:
+                taken, have = coll.transpose_panel_rows_windowed_parts(
+                    rp, gi_w, cs, g.nt
+                )
+                cp = ptu.consume_exchange(taken, have, COL_AXIS)
+            else:
+                cp = coll.transpose_panel_rows_windowed(rp, gi_w, cs, g.nt)
         with _scope("trtri.update"):
             xs = lax.dynamic_slice(x, (rs, cs, 0, 0), (L, C, g.mb, g.mb))
             keep = ((gi_w > k)[:, None] & (gi_w[:, None] <= gj_w[None, :]))[:, :, None, None]
-            s_part = t.contract("iab,ijbc->jac", cp, jnp.where(keep, xs, jnp.zeros_like(xs)))
+            xk = jnp.where(keep, xs, jnp.zeros_like(xs))
+            if fused_tier and ptu.update_kernel_ok(xs.dtype):
+                s_part = ptu.panel_contract(cp, xk, "iab,ijbc->jac")
+            else:
+                s_part = t.contract("iab,ijbc->jac", cp, xk)
             s_full = coll.psum_axis(s_part, ROW_AXIS)
             newrow = -t.contract("ab,jbc->jac", tkk, s_full)
         newrow = jnp.where(right & (myr == kr), newrow, xr)

@@ -205,12 +205,32 @@ def _red2band_step(p, carry, g: _spmd.Geometry, band: int, myr, myc, L: int, C: 
         # mask W2 to the trailing region (element rows >= start)
         ge = gi_w[:, None] * g.mb + jnp.arange(g.mb)[None, :]
         w2 = jnp.where((ge >= start)[:, :, None], w2, 0)
-        w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
-        xs = (
-            xs
-            - t.contract("iab,jcb->ijac", w2, vc.conj())
-            - t.contract("iab,jcb->ijac", vr, w2c.conj())
-        )
+        if _spmd.trailing_update_trace_key() == "fused":
+            from dlaf_tpu.ops import pallas_trailing_update as ptu
+
+            # first addend: both operands local — one-shot in-VMEM kernel
+            # (same jaxpr as the xla einsum; xla associates xs - c1 - c2 as
+            # ((xs - c1) - c2), which sequential application reproduces)
+            if ptu.update_kernel_ok(xs.dtype):
+                xs = ptu.trailing_update(xs, w2, vc.conj())
+            else:
+                xs = xs - t.contract("iab,jcb->ijac", w2, vc.conj())
+            # second addend: W2 crosses the diagonal — consume it out of
+            # the ring landing slots (no suppressed slots here: every
+            # window column takes its full contribution, matching xla)
+            taken, have = coll.transpose_panel_windowed_parts(
+                w2, gj_w, rs, g.mt
+            )
+            xs, _ = ptu.fused_transpose_update(
+                xs, vr, taken, have, jnp.zeros_like(have), ROW_AXIS
+            )
+        else:
+            w2c = coll.transpose_panel_windowed(w2, gj_w, rs, g.mt)
+            xs = (
+                xs
+                - t.contract("iab,jcb->ijac", w2, vc.conj())
+                - t.contract("iab,jcb->ijac", vr, w2c.conj())
+            )
         x = lax.dynamic_update_slice(x, xs, (rs, cs, 0, 0))
     # 4. write the factored panel strip back (element rows >= start on
     # the owning tile column; start is generally NOT tile-aligned)

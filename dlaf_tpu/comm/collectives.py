@@ -333,6 +333,20 @@ def transpose_panel(cp, nr_row_tiles, ltc: int):
     return _panel_exchange(taken, have, ROW_AXIS)
 
 
+def transpose_panel_windowed_parts(cp, jv, rs, nr_row_tiles):
+    """The (taken, have) pair of :func:`transpose_panel_windowed` WITHOUT
+    the exchange — the windowed sibling of :func:`transpose_panel_parts`,
+    consumed by the fused trailing-update transports (gen_to_std her2k,
+    TRTRI, red2band) so the bucketed slot map is stated exactly once."""
+    myr, _ = my_rank()
+    pr, _ = grid_shape()
+    L = cp.shape[0]
+    src_slot = jv // pr - rs
+    have = (jv % pr == myr) & (jv < nr_row_tiles) & (src_slot >= 0) & (src_slot < L)
+    taken = jnp.take(cp, jnp.clip(src_slot, 0, L - 1), axis=0)
+    return taken, have
+
+
 def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
     """Windowed variant of :func:`transpose_panel` for bucketed trailing
     updates: ``cp[L, ...]`` holds panel tiles for this rank's local row slots
@@ -340,13 +354,21 @@ def transpose_panel_windowed(cp, jv, rs, nr_row_tiles):
     ``rp[C, ...]`` with ``rp[c] = panel tile of global index jv[c]`` (zero
     where out of range).  ``rs`` may differ per rank row (each contributor
     uses its own window offset)."""
-    myr, _ = my_rank()
-    pr, _ = grid_shape()
-    L = cp.shape[0]
-    src_slot = jv // pr - rs
-    have = (jv % pr == myr) & (jv < nr_row_tiles) & (src_slot >= 0) & (src_slot < L)
-    taken = jnp.take(cp, jnp.clip(src_slot, 0, L - 1), axis=0)
+    taken, have = transpose_panel_windowed_parts(cp, jv, rs, nr_row_tiles)
     return _panel_exchange(taken, have, ROW_AXIS)
+
+
+def transpose_panel_rows_windowed_parts(rp, iv, cs, nr_col_tiles):
+    """The (taken, have) pair of :func:`transpose_panel_rows_windowed`
+    WITHOUT the exchange (column-axis mirror of
+    :func:`transpose_panel_windowed_parts`)."""
+    _, myc = my_rank()
+    _, pc = grid_shape()
+    C = rp.shape[0]
+    src_slot = iv // pc - cs
+    have = (iv % pc == myc) & (iv < nr_col_tiles) & (src_slot >= 0) & (src_slot < C)
+    taken = jnp.take(rp, jnp.clip(src_slot, 0, C - 1), axis=0)
+    return taken, have
 
 
 def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
@@ -357,12 +379,7 @@ def transpose_panel_rows_windowed(rp, iv, cs, nr_col_tiles):
     where out of range).  ``cs`` may differ per rank column (each
     contributor uses its own window offset); pass ``cs=0`` with a full
     ``C=ltc`` panel for the unwindowed-source case."""
-    _, myc = my_rank()
-    _, pc = grid_shape()
-    C = rp.shape[0]
-    src_slot = iv // pc - cs
-    have = (iv % pc == myc) & (iv < nr_col_tiles) & (src_slot >= 0) & (src_slot < C)
-    taken = jnp.take(rp, jnp.clip(src_slot, 0, C - 1), axis=0)
+    taken, have = transpose_panel_rows_windowed_parts(rp, iv, cs, nr_col_tiles)
     return _panel_exchange(taken, have, COL_AXIS)
 
 

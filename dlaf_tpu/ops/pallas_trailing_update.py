@@ -173,13 +173,90 @@ def trailing_update(x, a, b, subscripts: str = TRAILING_SUBSCRIPTS, *,
 
 
 def update_kernel_ok(dtype) -> bool:
-    """Whether :func:`trailing_update` can run for this dtype on this
-    backend: everywhere under the interpreter; real-only on compiled TPU
-    (Mosaic has no complex arithmetic — the float-pair trick needs the
-    interpreter's bitcast semantics)."""
+    """Whether :func:`trailing_update` / :func:`panel_contract` can run for
+    this dtype on this backend: everywhere under the interpreter; real-only
+    on compiled TPU (Mosaic has no complex arithmetic — the float-pair
+    trick needs the interpreter's bitcast semantics)."""
     if jax.default_backend() != "tpu":
         return True
     return not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def _contract_kernel(a_ref, b_ref, o_ref, *, subscripts, cdtype, tier):
+    """contract(subscripts, a, b), operands VMEM-resident — the one-shot
+    sibling of ``_update_kernel`` for contractions whose result feeds a
+    cross-rank reduction rather than an in-place subtraction."""
+    a, b = a_ref[...], b_ref[...]
+    if cdtype is not None:
+        a, b = a.view(cdtype), b.view(cdtype)
+    out = t.contract(subscripts, a, b, tier=tier)
+    if cdtype is not None:
+        out = out.view(a_ref.dtype)
+    o_ref[...] = out
+
+
+def panel_contract(a, b, subscripts: str, *,
+                   interpret: bool | None = None, tier: str | None = None):
+    """One panel contraction ``contract(subscripts, a, b)`` as a single
+    Pallas kernel (VMEM-resident operands, in-kernel split-GEMM).
+
+    Contractions that SUM over the panel slot axis — the TRTRI column
+    update ``ijab,jbc->iac`` and its upper mirror — have a cross-slot
+    accumulation order, so applying hops out of the ring landing slots
+    would reassociate that sum (NOT bit-safe, unlike
+    ``TRAILING_SUBSCRIPTS``).  The fused tier instead pairs the consume
+    ring TRANSPORT (:func:`consume_exchange`) with this one-shot in-VMEM
+    contraction: same jaxpr as the XLA tier's ``tile.contract``, so
+    interpret-mode execution is bit-equal (the tier-1 parity contract).
+    Note this is ``contract``, not ``0 - contract`` via
+    :func:`trailing_update` on zeros — ``0.0 - x`` flips the sign bit of
+    signed zeros where ``-x`` (applied by the caller) does not."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    fdt, cdtype = _pair_dtype(a.dtype)
+    osd = jax.eval_shape(
+        lambda a_, b_: t.contract(subscripts, a_, b_, tier=tier), a, b
+    )
+    oshape, odtype = osd.shape, osd.dtype
+    aw, bw = a, b
+    if cdtype is not None:
+        aw, bw = a.view(fdt), b.view(fdt)
+        oshape = oshape[:-1] + (2 * oshape[-1],)
+        odtype = fdt
+    out = pl.pallas_call(
+        functools.partial(
+            _contract_kernel, subscripts=subscripts, cdtype=cdtype, tier=tier
+        ),
+        out_shape=jax.ShapeDtypeStruct(oshape, odtype),
+        interpret=interpret,
+    )(aw, bw)
+    if cdtype is not None:
+        out = out.view(cdtype)
+    return out
+
+
+def consume_exchange(taken, have, ring_axis: str, *, mesh_axes=("r", "c")):
+    """The consume ring's TRANSPORT alone: exchange the one-contributor
+    panel parts along ``ring_axis`` and return the merged panel (zero where
+    no rank contributed), recorded as ``transpose_panel_fused``.
+
+    Callers whose trailing contraction sums across panel slots (TRTRI)
+    pair this with :func:`panel_contract` instead of consuming per hop —
+    the ring schedule and ``collective_id_for('consume', axis)`` class
+    match :func:`dma_ring_consume`; only the application is hoisted out of
+    the hop loop into the one-shot kernel.  Bit-identical to the
+    ``_panel_exchange`` transports (one-contributor pure-select merges)."""
+    from dlaf_tpu.obs.comms import record as _rec
+
+    _rec("transpose_panel_fused", taken, ring_axis)
+    if ppe._axis_size(ring_axis) == 1:
+        hmask = have.reshape(have.shape + (1,) * (taken.ndim - have.ndim))
+        return jnp.where(hmask, taken, jnp.zeros_like(taken))
+    y, have_all = ppe.ring_exchange(
+        taken, have, ring_axis, mesh_axes=tuple(mesh_axes), kind="consume"
+    )
+    amask = have_all.reshape(have_all.shape + (1,) * (y.ndim - have_all.ndim))
+    return jnp.where(amask, y, jnp.zeros_like(y))
 
 
 # ------------------------------------------------------- consume ring kernel

@@ -1,0 +1,149 @@
+"""Idle-replica shadow sweeps: the fleet measures itself.
+
+PR 16's telemetry plane harvests service times the fleet HAPPENS to
+observe; this module makes the fleet generate measurements when nothing
+else is running — the other half of ROADMAP item 4.  A
+:class:`ShadowSweeper` is ticked by the ``Fleet`` monitor thread.  When
+the fleet has sat idle past ``tune.telemetry_shadow_idle_s`` it runs a
+short sweep of micro-geometries (drawn from the harvested per-(op,bucket)
+traffic mix) on the least-loaded replica, folds the timings into the
+persistent ``harvested-profile.json`` with ``source='shadow_sweep'``
+provenance, and re-installs the profile so ``autotune.decide`` answers
+from measurement (``source='profile'``) instead of the analytic model —
+each changed answer audited as a ``plan/autotune_flip`` event.
+
+Design rules:
+
+- **Real work always wins.**  The busy probe is consulted before every
+  measurement AND by every tick; a sweep in flight is aborted the moment
+  backlog appears, so at most the one in-flight micro-batch finishes
+  behind real traffic (the preemption bound the tests assert).
+- **Pure scheduling, injected effects** (the ``serve.autoscale`` shape):
+  the sweeper owns only clocks and thresholds; what "busy", "measure",
+  "geometries" and "fold" mean is the caller's business, which is what
+  makes the preemption contract testable without a fleet.
+- **Measurement must never hurt serving**: any exception inside the
+  sweep is recorded (``shadow_sweep_error``) and ends the sweep; it never
+  propagates into the monitor thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from dlaf_tpu.obs import metrics as om
+
+
+class ShadowSweeper:
+    """Idle-triggered micro-sweep scheduler.
+
+    Parameters
+    ----------
+    busy_fn: () -> bool — is there real work the sweep would compete with?
+    measure_fn: (geometry) -> float — run ONE micro-batch of the geometry
+        on an idle replica and return wall seconds.
+    geometries_fn: () -> iterable of geometries (opaque to the sweeper;
+        the fleet uses ``(op, n, dtype_str)`` drawn from the harvested
+        traffic mix).
+    fold_fn: (results) -> None — persist ``[(geometry, seconds), ...]``.
+    idle_s: quiet seconds required before a sweep may start.
+    cooldown_s: minimum spacing between sweep starts (idleness is
+        re-armed after every sweep, so a permanently idle fleet sweeps at
+        most every ``idle_s + cooldown_s``).
+    max_geometries: cap per sweep — a sweep is a probe, not a benchmark
+        campaign.
+    background: run the sweep on a daemon thread (the fleet monitor must
+        not block); tests set False for deterministic inline execution.
+    """
+
+    def __init__(self, busy_fn, measure_fn, geometries_fn, fold_fn, *,
+                 idle_s: float, cooldown_s: float = 60.0,
+                 max_geometries: int = 4, now_fn=time.monotonic,
+                 background: bool = True):
+        self._busy = busy_fn
+        self._measure = measure_fn
+        self._geometries = geometries_fn
+        self._fold = fold_fn
+        self.idle_s = float(idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self.max_geometries = int(max_geometries)
+        self._now = now_fn
+        self.background = background
+        self._idle_since = None
+        self._last_done = None
+        self._thread = None
+        self._abort = threading.Event()
+        self.sweeps = 0
+        self.measured = 0
+        self.aborted = 0
+
+    def sweeping(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def tick(self) -> str:
+        """One scheduler pass; returns the state it observed/acted on:
+        ``busy`` (idle clock reset, running sweep told to abort),
+        ``sweeping`` (a sweep is in flight), ``arming`` (idle but not yet
+        past ``idle_s``), ``cooldown``, or ``started``."""
+        now = self._now()
+        if self._busy():
+            self._idle_since = None
+            if self.sweeping():
+                self._abort.set()  # real work wins: stop after this batch
+            return "busy"
+        if self.sweeping():
+            return "sweeping"
+        if self._idle_since is None:
+            self._idle_since = now
+            return "arming"
+        if now - self._idle_since < self.idle_s:
+            return "arming"
+        if self._last_done is not None and now - self._last_done < self.cooldown_s:
+            return "cooldown"
+        self._abort.clear()
+        if self.background:
+            self._thread = threading.Thread(
+                target=self._run, name="dlaf-shadow-sweep", daemon=True
+            )
+            self._thread.start()
+        else:
+            self._run()
+        return "started"
+
+    def _run(self) -> None:
+        results, was_aborted = [], False
+        try:
+            geoms = list(self._geometries())[: self.max_geometries]
+        except Exception as e:
+            om.emit("plan", event="shadow_sweep_error", stage="geometries",
+                    error=repr(e))
+            geoms = []
+        om.emit("plan", event="shadow_sweep_start", geometries=len(geoms))
+        for geom in geoms:
+            # the preemption bound: checked BEFORE every measurement, so
+            # real work waits behind at most the in-flight micro-batch
+            if self._abort.is_set() or self._busy():
+                was_aborted = True
+                break
+            try:
+                seconds = self._measure(geom)
+            except Exception as e:
+                om.emit("plan", event="shadow_sweep_error", stage="measure",
+                        geometry=list(geom), error=repr(e))
+                was_aborted = True
+                break
+            results.append((geom, float(seconds)))
+        if results:
+            try:
+                self._fold(results)
+            except Exception as e:
+                om.emit("plan", event="shadow_sweep_error", stage="fold",
+                        error=repr(e))
+        self.measured += len(results)
+        self.sweeps += 1
+        self.aborted += int(was_aborted)
+        self._last_done = self._now()
+        self._idle_since = None  # re-arm: next sweep needs fresh idleness
+        om.emit("plan", event="shadow_sweep_done",
+                measured=len(results), aborted=bool(was_aborted))

@@ -19,6 +19,12 @@ import json
 import time
 
 
+#: ops timed through the distributed drivers on the ambient device grid
+#: (vs the batched serve executables) — the geometries whose performance
+#: the ``tune.trailing_update_impl`` tier changes
+DIST_OPS = ("gen_to_std", "trtri", "red2band")
+
+
 def _candidates(n: int, nbs) -> list:
     if nbs:
         return sorted({min(int(v), n) for v in nbs})
@@ -54,6 +60,56 @@ def _time_op(op: str, n: int, dtype, nb: int, batch: int, repeat: int, cache):
     return best
 
 
+def _time_dist_op(op: str, n: int, dtype, nb: int, repeat: int, grid):
+    """Time one distributed-driver geometry on the ambient grid (these
+    are the consumers the fused trailing-update tier rewrites, so their
+    entries are what a measured xla-vs-fused comparison keys on)."""
+    import numpy as np
+
+    import dlaf_tpu.testing as tu
+    from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    spd = tu.random_hermitian_pd(n, dtype, seed=17)
+
+    if op == "gen_to_std":
+        from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+
+        a = np.tril(spd)
+        fac = np.linalg.cholesky(tu.random_hermitian_pd(n, dtype, seed=18))
+
+        def run():
+            ma = DistributedMatrix.from_global(grid, a, (nb, nb))
+            mf = DistributedMatrix.from_global(grid, fac, (nb, nb))
+            generalized_to_standard("L", ma, mf).data.block_until_ready()
+    elif op == "trtri":
+        from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+        l = np.linalg.cholesky(spd)
+
+        def run():
+            ml = DistributedMatrix.from_global(grid, l, (nb, nb))
+            triangular_inverse("L", "N", ml).data.block_until_ready()
+    elif op == "red2band":
+        from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+        a = np.tril(spd)
+
+        def run():
+            ma = DistributedMatrix.from_global(grid, a, (nb, nb))
+            out, taus = reduction_to_band(ma)
+            out.data.block_until_ready()
+    else:
+        raise ValueError(f"sweep: unknown distributed op {op!r}")
+
+    run()  # compile outside the timed region
+    best = float("inf")
+    for _ in range(max(1, repeat)):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def sweep(ops, ns, dtypes, *, nbs=(), batch=4, repeat=2,
           collectives=(), out=None, verbose=True) -> dict:
     """Run the sweep and return (and optionally write) the profile dict."""
@@ -61,9 +117,19 @@ def sweep(ops, ns, dtypes, *, nbs=(), batch=4, repeat=2,
     import numpy as np
 
     from dlaf_tpu import tune
+    from dlaf_tpu.algorithms import _spmd
     from dlaf_tpu.plan import autotune
     from dlaf_tpu.serve import bucketing
 
+    grid = None
+    if any(op in DIST_OPS for op in ops):
+        from dlaf_tpu.comm.grid import Grid
+
+        grid = Grid.create()
+    # the tier each measurement actually ran under: a profile row timed
+    # with the fused consumer must not steer an xla-tier run (and vice
+    # versa), so every row records the resolved impl
+    impl = _spmd.trailing_update_trace_key()
     entries = []
     for dtype in dtypes:
         dt = np.dtype(dtype)
@@ -74,7 +140,10 @@ def sweep(ops, ns, dtypes, *, nbs=(), batch=4, repeat=2,
                 cands = []
                 # eigh's dense executable has no tile blocking: one candidate
                 for nb in ([n] if op == "eigh" else _candidates(n, nbs)):
-                    s = _time_op(op, n, dt, nb, batch, repeat, cache)
+                    if op in DIST_OPS:
+                        s = _time_dist_op(op, n, dt, nb, repeat, grid)
+                    else:
+                        s = _time_op(op, n, dt, nb, batch, repeat, cache)
                     cands.append({"nb": nb, "seconds": s})
                     if verbose:
                         print(f"sweep: {op} n={n} {dt.str} nb={nb}: {s:.4f}s")
@@ -84,6 +153,7 @@ def sweep(ops, ns, dtypes, *, nbs=(), batch=4, repeat=2,
                     "choice": {"nb": best["nb"],
                                "shard_batch": autotune.shard_batch(op, n, dt)},
                     "seconds": best["seconds"], "candidates": cands,
+                    "trailing_update_impl": impl,
                 })
     prof = {
         "schema": autotune.PROFILE_SCHEMA,
@@ -123,7 +193,9 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="measured autotune sweep -> JSON profile "
                     "(load via DLAF_TPU_PLAN_PROFILE)")
-    p.add_argument("--ops", default="potrf,posv")
+    p.add_argument("--ops", default="potrf,posv",
+                   help="serve ops (potrf,posv,eigh) and/or distributed "
+                        "drivers (gen_to_std,trtri,red2band)")
     p.add_argument("--ns", default="", help="comma-separated bucket orders "
                    "(default: tune.serve_buckets)")
     p.add_argument("--dtypes", default="float32")

@@ -108,6 +108,7 @@ class Fleet:
             env["DLAF_TPU_TELEMETRY"] = "1"
         self.probe_budget_s = float(probe_budget_s)
         self.ready_timeout_s = float(ready_timeout_s)
+        self._warm_ops = tuple(warm_ops)
         self._fake = fake
         self._max_queue = max_queue
         self._lock = threading.Lock()
@@ -157,6 +158,18 @@ class Fleet:
                 min_workers=int(min_workers), max_workers=int(max_workers),
                 **{"burn_fn": self.burn_monitor.hot,
                    **(autoscale_kwargs or {})},
+            )
+        # idle-replica shadow sweeps (plan.shadow): when the fleet sits
+        # quiet past the knob, measure a few harvested geometries on the
+        # least-loaded replica and fold them into the persistent profile
+        self.shadow = None
+        if p.telemetry_shadow_idle_s > 0:
+            from dlaf_tpu.plan.shadow import ShadowSweeper
+
+            self.shadow = ShadowSweeper(
+                self._shadow_busy, self._shadow_measure,
+                self._shadow_geometries, self._shadow_fold,
+                idle_s=p.telemetry_shadow_idle_s,
             )
 
     # -------------------------------------------------------------- workers
@@ -229,6 +242,8 @@ class Fleet:
         self.burn_monitor.check()
         if self.autoscaler is not None:
             self.autoscaler.step()
+        if self.shadow is not None:
+            self.shadow.tick()
         return summary
 
     # ------------------------------------------------------------ elasticity
@@ -285,6 +300,10 @@ class Fleet:
         threading.Thread(target=handle.close,
                          name=f"dlaf-fleet-retire-{handle.name}",
                          daemon=True).start()
+        # the retiring worker's batch records would otherwise sit in its
+        # JSONL until close(); harvest now so a long-lived fleet's profile
+        # tracks the traffic it has actually served, not just the finale
+        self._harvest_service_times(include_worker_files=True)
 
     # ------------------------------------------------------------- signals
 
@@ -360,13 +379,19 @@ class Fleet:
                 fields.setdefault("worker", worker)
                 om.emit(rec["kind"], **fields)
 
-    def _harvest_service_times(self) -> None:
+    def _harvest_service_times(self, include_worker_files: bool = False) -> None:
         """Roll the merged stream's completed-batch records (the workers'
         ``serve``/``batch`` events carry geometry + launch choice) into a
         persisted ``plan`` profile.  Point ``DLAF_TPU_PLAN_PROFILE`` at
         ``profile_path`` and the next run's ``plan/autotune.decide``
         resolves those geometries with ``source='profile'`` — real fleet
-        data steering the analytic model."""
+        data steering the analytic model.
+
+        ``include_worker_files`` reads the per-worker JSONLs directly —
+        the mid-run (scale-down) harvest, where the parent stream does not
+        yet carry the merged worker records.  At close() the merge has
+        already folded them in, so the flag stays False there or every
+        batch would count twice."""
         em = om.get()
         if em is None:
             return
@@ -374,15 +399,139 @@ class Fleet:
 
         harvester = tlm.ServiceTimeHarvester(
             min_samples=get_tune_parameters().telemetry_harvest_min_samples)
-        try:
-            fed = harvester.ingest(om.read_jsonl(em.path))
-        except (OSError, ValueError):
-            return
+        paths = [em.path]
+        if include_worker_files:
+            paths.extend(sorted(glob.glob(os.path.join(self.base_dir,
+                                                       "worker-*.jsonl"))))
+        fed = 0
+        for path in paths:
+            try:
+                fed += harvester.ingest(om.read_jsonl(path))
+            except (OSError, ValueError):
+                continue
         if not fed:
             return
         path = os.path.join(self.base_dir, "harvested-profile.json")
         if harvester.write(path) is not None:
             self.profile_path = path
+
+    # -------------------------------------------------------- shadow sweeps
+
+    def _shadow_busy(self) -> bool:
+        """Real work the sweep would compete with: any gateway backlog or
+        outstanding worker frame (the autoscaler's own backlog signal)."""
+        return self._signals()[1] > 0
+
+    def _shadow_geometries(self):
+        """Micro-geometries worth measuring: the ``(op, n, dtype)`` mix
+        the fleet has actually served (one pass of the harvester over the
+        parent stream AND the live worker JSONLs, min_samples=1 — this is
+        discovery, not statistics).  A fleet idle since birth probes the
+        smallest serve bucket for each warmed op instead."""
+        import numpy as np
+
+        harvester = tlm.ServiceTimeHarvester(min_samples=1)
+        em = om.get()
+        paths = [em.path] if em is not None else []
+        paths.extend(sorted(glob.glob(os.path.join(self.base_dir,
+                                                   "worker-*.jsonl"))))
+        for path in paths:
+            try:
+                harvester.ingest(om.read_jsonl(path))
+            except (OSError, ValueError):
+                continue
+        geoms = [(e["op"], int(e["n"]), e["dtype"])
+                 for e in harvester.profile()["entries"]]
+        if not geoms:
+            from dlaf_tpu.serve import bucketing
+
+            b0 = bucketing.bucket_table()[0]
+            f4 = np.dtype(np.float32).str
+            geoms = [(op, b0, f4) for op in self._warm_ops]
+        return geoms
+
+    def _shadow_measure(self, geom) -> float:
+        """Run ONE micro-batch of ``(op, n, dtype)`` on the least-loaded
+        healthy replica and return its wall seconds (wire round trip
+        included — that is the latency serving actually sees)."""
+        import numpy as np
+
+        from dlaf_tpu.serve import pool as serve_pool
+
+        op, n, dtype_str = geom
+        dt = np.dtype(dtype_str)
+        rng = np.random.default_rng(int(n))
+        r = rng.standard_normal((n, n))
+        if dt.kind == "c":
+            r = r + 1j * rng.standard_normal((n, n))
+        a = (r @ np.conj(r.T) + n * np.eye(n)).astype(dt)
+        b = rng.standard_normal((n, 1)).astype(dt) if op == "posv" else None
+        req = serve_pool.make_request(op, "L", a, b)
+        live = self.router.healthy()
+        if not live:
+            raise DistributionError("shadow sweep: no healthy replica")
+        target = min(live, key=lambda rep: rep.pending())
+        t0 = time.monotonic()
+        if target.pool.adopt([req]):
+            raise DistributionError(
+                f"shadow sweep: replica {target.name} refused the probe")
+        req.future.result(timeout=max(self.probe_budget_s * 12, 60.0))
+        return time.monotonic() - t0
+
+    def _shadow_fold(self, results) -> None:
+        """Upsert sweep measurements into ``harvested-profile.json`` with
+        ``source='shadow_sweep'`` provenance, re-install the profile, and
+        audit every ``autotune.decide`` answer the new entries changed as
+        a ``plan``/``autotune_flip`` event."""
+        import json
+
+        from dlaf_tpu.algorithms import _spmd
+        from dlaf_tpu.plan import autotune
+
+        before = {geom: autotune.decide(*geom).source for geom, _ in results}
+        path = os.path.join(self.base_dir, "harvested-profile.json")
+        doc = None
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            doc = None
+        if not isinstance(doc, dict) or doc.get("schema") != autotune.PROFILE_SCHEMA:
+            doc = {"schema": autotune.PROFILE_SCHEMA, "entries": []}
+        doc["harvest"] = {**doc.get("harvest", {}), "source": "shadow_sweep",
+                          "shadow_sweeps": int(doc.get("harvest", {})
+                                               .get("shadow_sweeps", 0)) + 1}
+        impl = _spmd.trailing_update_trace_key()
+        entries = {(e.get("op"), int(e.get("n", 0)), e.get("dtype")): e
+                   for e in doc.get("entries", ()) if isinstance(e, dict)}
+        for geom, seconds in results:
+            op, n, ds = geom
+            e = entries.setdefault((op, int(n), ds),
+                                   {"op": op, "n": int(n), "dtype": ds})
+            meas = e.setdefault("measured", {})
+            batches = int(meas.get("batches", 0)) + 1
+            total = float(meas.get("mean_batch_s", 0.0)) * (batches - 1) + seconds
+            meas.update(batches=batches, items=int(meas.get("items", 0)) + 1,
+                        mean_batch_s=total / batches,
+                        mean_item_s=total / batches)
+            e["source"] = "shadow_sweep"
+            e["trailing_update_impl"] = impl
+            e.setdefault("choice", {})
+        doc["entries"] = [entries[k] for k in sorted(entries)]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, path)
+        self.profile_path = path
+        autotune.load_profile(path)
+        for geom in before:  # unique geometries: one audit row each
+            after = autotune.decide(*geom).source
+            if after != before[geom]:
+                op, n, ds = geom
+                om.emit("plan", event="autotune_flip", op=op, n=int(n),
+                        dtype=ds, before=before[geom], after=after,
+                        trailing_update_impl=impl)
 
     def __enter__(self):
         return self

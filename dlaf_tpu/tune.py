@@ -251,6 +251,12 @@ class TuneParameters:
     - ``telemetry_harvest_min_samples``: completed batches a geometry
       needs before the service-time harvester includes it in the
       persisted plan profile (fewer = noise steering the autotuner).
+    - ``telemetry_shadow_idle_s``: seconds a serve fleet must sit idle
+      (no gateway backlog, no pending work) before the fleet monitor
+      starts a shadow sweep on the least-loaded replica — micro
+      measurements of the harvested traffic mix folded into the plan
+      profile (``plan.shadow``).  0 (default) disables shadow sweeps;
+      real work preempts a running sweep within one micro-batch.
     - ``slo_burn_target_p95_s``: per-request latency above this counts
       against the tenant's error budget in the SLO burn-rate monitor
       (sheds always count).
@@ -357,6 +363,9 @@ class TuneParameters:
     telemetry_harvest_min_samples: int = field(
         default_factory=lambda: _env("telemetry_harvest_min_samples", 8, int)
     )
+    telemetry_shadow_idle_s: float = field(
+        default_factory=lambda: _env("telemetry_shadow_idle_s", 0.0, float)
+    )
     slo_burn_target_p95_s: float = field(
         default_factory=lambda: _env("slo_burn_target_p95_s", 2.0, float)
     )
@@ -395,7 +404,7 @@ class TuneParameters:
                 validate_matmul_precision(v, knob=k)
             elif k.startswith("serve_fleet_"):
                 validate_serve_fleet_knob(k, v)
-            elif k.startswith("slo_burn_") or k == "telemetry_harvest_min_samples":
+            elif k.startswith("slo_burn_") or k.startswith("telemetry_"):
                 validate_telemetry_knob(k, v)
             setattr(self, k, v)
         return self
@@ -542,6 +551,9 @@ def validate_telemetry_knob(knob: str, value) -> None:
     if knob == "telemetry_harvest_min_samples":
         ok = v >= 1 and float(v).is_integer()
         domain = "an integer >= 1"
+    elif knob == "telemetry_shadow_idle_s":
+        ok = v >= 0
+        domain = ">= 0 (0 disables shadow sweeps)"
     elif knob == "slo_burn_budget":
         ok = 0 < v <= 1
         domain = "a fraction in (0, 1]"

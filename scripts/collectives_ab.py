@@ -43,17 +43,59 @@ TIERS = ("psum", "v2", "pallas")
 FUSED_TIER = "fused"
 
 
-def _bench_tier(tier, grid, args, om, ocomms):
+#: benchable consumers and their approximate flop counts (for a relative
+#: A/B the absolute constant matters less than using the SAME one per op)
+OPS = ("potrf", "gen_to_std", "trtri", "red2band")
+_FLOPS = {
+    "potrf": lambda m: m**3 / 3,
+    "gen_to_std": lambda m: m**3,
+    "trtri": lambda m: m**3 / 3,
+    "red2band": lambda m: 4 * m**3 / 3,
+}
+
+
+def _op_runner(op, grid, args):
+    """(fresh-input factory, driver) for one benchable op."""
     import numpy as np
 
     import dlaf_tpu.testing as tu
-    from dlaf_tpu import tune
-    from dlaf_tpu.algorithms.cholesky import cholesky_factorization
-    from dlaf_tpu.health import DeviceUnresponsiveError
     from dlaf_tpu.matrix.matrix import DistributedMatrix
+
+    spd = tu.random_hermitian_pd(args.m, np.float32, seed=11)
+    mb = (args.mb, args.mb)
+    dist = lambda arr: DistributedMatrix.from_global(grid, arr, mb)
+    if op == "potrf":
+        from dlaf_tpu.algorithms.cholesky import cholesky_factorization
+
+        a = np.tril(spd)
+        return (lambda: dist(a)), lambda m: cholesky_factorization("L", m)
+    if op == "gen_to_std":
+        from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+
+        a = np.tril(spd)
+        fac = np.linalg.cholesky(tu.random_hermitian_pd(args.m, np.float32,
+                                                        seed=12))
+        return ((lambda: (dist(a), dist(fac))),
+                lambda ms: generalized_to_standard("L", *ms))
+    if op == "trtri":
+        from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+        l = np.linalg.cholesky(spd)
+        return (lambda: dist(l)), lambda m: triangular_inverse("L", "N", m)
+    if op == "red2band":
+        from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+        a = np.tril(spd)
+        return (lambda: dist(a)), lambda m: reduction_to_band(m)[0]
+    raise SystemExit(f"collectives_ab: unknown --op {op!r}; use {OPS}")
+
+
+def _bench_tier(tier, grid, args, om, ocomms):
+    from dlaf_tpu import tune
+    from dlaf_tpu.health import DeviceUnresponsiveError
     from dlaf_tpu.resilience import DeviceWatchdog
 
-    row = {"tier": tier, "m": args.m, "mb": args.mb,
+    row = {"tier": tier, "op": args.op, "m": args.m, "mb": args.mb,
            "grid": list(grid.grid_size), "nruns": args.nruns}
     try:
         row["probe_s"] = DeviceWatchdog(budget_s=args.probe_budget).probe()
@@ -69,14 +111,16 @@ def _bench_tier(tier, grid, args, om, ocomms):
     else:
         tune.get_tune_parameters().update(
             collectives_impl=tier, trailing_update_impl="xla")
-    a = np.tril(tu.random_hermitian_pd(args.m, np.float32, seed=11))
+    make_inputs, driver = _op_runner(args.op, grid, args)
     ocomms.start()
     times = []
     for i in range(-1, args.nruns):  # one warmup (the compile) + timed runs
-        mat = DistributedMatrix.from_global(grid, a, (args.mb, args.mb))
-        mat.data.block_until_ready()
+        inputs = make_inputs()
+        mats = inputs if isinstance(inputs, tuple) else (inputs,)
+        for m_ in mats:
+            m_.data.block_until_ready()
         t0 = time.perf_counter()
-        out = cholesky_factorization("L", mat)
+        out = driver(inputs)
         out.data.block_until_ready()
         dt = time.perf_counter() - t0
         if i >= 0:
@@ -84,7 +128,7 @@ def _bench_tier(tier, grid, args, om, ocomms):
     acc = ocomms.stop()
     rows = ocomms.as_records(acc)
     best = min(times)
-    gflops = args.m**3 / 3 / best / 1e9
+    gflops = _FLOPS[args.op](args.m) / best / 1e9
     wire = sum(r["modeled_wire_bytes"] for r in rows)
     overlapped = sum(r["overlapped_wire_bytes"] for r in rows)
     row.update(
@@ -97,11 +141,11 @@ def _bench_tier(tier, grid, args, om, ocomms):
     print(f"[{tier}] {best:.4f}s {gflops:.2f} GFlop/s  wire {wire}B "
           f"(exposed {wire - overlapped}B, overlapped {overlapped}B)")
     if om is not None:
-        om.emit("run", name=f"potrf_{tier}", run_index=0, seconds=best,
+        om.emit("run", name=f"{args.op}_{tier}", run_index=0, seconds=best,
                 gflops=gflops, m=args.m, mb=args.mb,
                 grid=list(grid.grid_size), dtype="s")
         om.emit_comms(acc)
-        om.emit("bench", record={"metric": f"potrf_gflops_{tier}",
+        om.emit("bench", record={"metric": f"{args.op}_gflops_{tier}",
                                  "value": gflops, "unit": "GFlop/s",
                                  "wire_bytes": wire,
                                  "overlapped_wire_bytes": overlapped})
@@ -110,6 +154,8 @@ def _bench_tier(tier, grid, args, om, ocomms):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--op", default="potrf", choices=OPS,
+                    help="consumer to A/B (each gets its own artifact)")
     ap.add_argument("--m", type=int, default=4096)
     ap.add_argument("--mb", type=int, default=512)
     ap.add_argument("--nruns", type=int, default=2)
@@ -150,8 +196,13 @@ def main(argv=None) -> int:
     # restore the caller's knobs afterwards
     tp = tune.get_tune_parameters()
     saved = (tp.collectives_impl, tp.cholesky_lookahead,
-             tp.trailing_update_impl)
+             tp.trailing_update_impl, tp.trsm_lookahead,
+             tp.gen_to_std_backend)
     tp.update(cholesky_lookahead=True)
+    if args.op == "gen_to_std":
+        # the her2k backend + lookahead'd solves are where the fused
+        # consumer applies; the composed backend would A/B nothing
+        tp.update(gen_to_std_backend="fused", trsm_lookahead=True)
     try:
         results = [
             _bench_tier(t.strip(), grid, args, om, ocomms)
@@ -159,7 +210,8 @@ def main(argv=None) -> int:
         ]
     finally:
         tp.update(collectives_impl=saved[0], cholesky_lookahead=saved[1],
-                  trailing_update_impl=saved[2])
+                  trailing_update_impl=saved[2], trsm_lookahead=saved[3],
+                  gen_to_std_backend=saved[4])
         if om is not None:
             om_mod.close()
     if args.out:

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Idle fleet -> shadow sweep -> profile -> decide(source='profile').
+
+Usage: python scripts/shadow_smoke.py out.jsonl
+
+CI's serve-fleet lane runs this as the shadow-sweep acceptance gate: a
+1-worker fleet serves a handful of POTRF requests, then sits idle past
+``tune.telemetry_shadow_idle_s``.  The monitor tick must start a shadow
+sweep that re-measures the served geometry on the idle replica, fold the
+timings into ``harvested-profile.json`` with ``source='shadow_sweep'``
+provenance, flip ``plan/autotune.decide`` for that geometry to
+``source='profile'`` (audited as a ``plan``/``autotune_flip`` record in
+``out.jsonl``), and leave the served latency distribution untouched —
+the sweep ran when nothing else wanted the replica.  Exit is nonzero if
+any check fails.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DLAF_TPU_TELEMETRY", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = argv[0] if argv else "shadow_smoke.jsonl"
+
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from dlaf_tpu import serve, tune
+    from dlaf_tpu.obs import metrics as om
+    from dlaf_tpu.plan import autotune
+    from dlaf_tpu.testing import random_hermitian_pd
+
+    om.enable(path)
+    om.emit_run_meta("shadow_smoke")
+    tune.initialize(serve_buckets="16",
+                    telemetry_shadow_idle_s=0.3,
+                    telemetry_harvest_min_samples=1)
+    failures = []
+
+    def expect(cond, what):
+        print(("ok  " if cond else "FAIL") + f"  {what}")
+        if not cond:
+            failures.append(what)
+
+    base_dir = tempfile.mkdtemp(prefix="dlaf-shadow-smoke-")
+    fleet = serve.Fleet(
+        [serve.TenantConfig("t", max_pending=64)],
+        workers=1, buckets="16", block_size=8, max_batch=4,
+        warm_ops=("potrf",), base_dir=base_dir,
+    )
+    try:
+        expect(fleet.shadow is not None,
+               "telemetry_shadow_idle_s > 0 arms the fleet's ShadowSweeper")
+
+        async def drive():
+            a = random_hermitian_pd(12, np.float64, seed=3)
+            return await asyncio.gather(*(
+                fleet.gateway.submit("t", "potrf", "L", a) for _ in range(4)))
+
+        results = asyncio.run(drive())
+        expect(all(r.info == 0 for r in results), "served requests solve OK")
+        p95_before = fleet._signals()[0]
+
+        # idle now: tick the monitor until a sweep has run and folded
+        deadline = time.monotonic() + 120.0
+        while fleet.shadow.sweeps == 0 and time.monotonic() < deadline:
+            fleet.tick()
+            time.sleep(0.05)
+        while fleet.shadow.sweeping() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        expect(fleet.shadow.sweeps >= 1, "idle fleet started a shadow sweep")
+        expect(fleet.shadow.measured >= 1, "sweep measured >= 1 geometry")
+        expect(fleet.profile_path is not None
+               and os.path.exists(fleet.profile_path or ""),
+               "sweep folded into a persisted profile")
+        doc = json.load(open(fleet.profile_path))
+        expect(doc.get("schema") == autotune.PROFILE_SCHEMA,
+               "profile document carries the plan.profile schema")
+        expect(doc.get("harvest", {}).get("source") == "shadow_sweep",
+               "profile provenance records source='shadow_sweep'")
+        swept = [e for e in doc.get("entries", ())
+                 if e.get("source") == "shadow_sweep"]
+        expect(len(swept) >= 1, "profile holds shadow-swept entries")
+        flips = decided = 0
+        for e in swept:
+            d = autotune.decide(e["op"], e["n"], e["dtype"])
+            decided += int(d.source == "profile")
+        expect(decided == len(swept),
+               "decide() answers every swept geometry with source='profile'")
+        # the flip audit landed in the stream (emit flushes per line)
+        flips = sum(1 for r in om.read_jsonl(path)
+                    if r.get("event") == "autotune_flip"
+                    and r.get("after") == "profile")
+        expect(flips >= 1, "autotune_flip audit record emitted")
+        # zero effect on served latency: nothing was queued behind the
+        # sweep, so the gateway's latency distribution is untouched
+        expect(fleet._signals() == (p95_before, 0),
+               "shadow sweep left served p95 and backlog untouched")
+    finally:
+        fleet.close()
+        om.close()
+    if failures:
+        print(f"shadow_smoke: {len(failures)} check(s) failed")
+        return 1
+    print("shadow_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -100,16 +100,21 @@ timeout 900 python scripts/collectives_ab.py --m 8192 --mb 512 --nruns 2 \
 timeout 900 python scripts/precision_ab.py --m 4096 --mb 512 --nrhs 16 --nruns 2 \
   --out "$OUT/05_precision_ab.json" --metrics "$OUT/05_precision_ab.jsonl" \
   > "$OUT/05_precision_ab.log" 2>&1
-#    (h) fused trailing-update consumer: pallas vs pallas+fused lookahead
-#        POTRF A/B (watchdog-probed per leg; DeviceUnresponsiveError
-#        stale-flags the row and the flight recorder drops flight_*.json).
-#        THE decision gate for promoting 'fused' into the
-#        trailing_update_impl 'auto' resolution — the CPU mesh only
-#        proves bit parity, never the VMEM-residency win.
-timeout 900 python scripts/collectives_ab.py --m 8192 --mb 512 --nruns 2 \
-  --tiers pallas,fused --flight-dir "$OUT" \
-  --out "$OUT/05_trailing_ab.json" --metrics "$OUT/05_trailing_ab.jsonl" \
-  > "$OUT/05_trailing_ab.log" 2>&1
+#    (h) fused trailing-update consumer: pallas vs pallas+fused A/B per
+#        consumer op — lookahead POTRF plus the PR-18 coverage (her2k
+#        gen_to_std, TRTRI, red2band), one artifact per op (watchdog-
+#        probed per leg; DeviceUnresponsiveError stale-flags the row and
+#        the flight recorder drops flight_*.json).  THE decision gate for
+#        promoting 'fused' into the trailing_update_impl 'auto'
+#        resolution — the CPU mesh only proves bit parity, never the
+#        VMEM-residency win.
+for OP in potrf gen_to_std trtri red2band; do
+  timeout 900 python scripts/collectives_ab.py --op $OP --m 8192 --mb 512 \
+    --nruns 2 --tiers pallas,fused --flight-dir "$OUT" \
+    --out "$OUT/05_trailing_ab_$OP.json" \
+    --metrics "$OUT/05_trailing_ab_$OP.jsonl" \
+    > "$OUT/05_trailing_ab_$OP.log" 2>&1
+done
 
 # 6. one profiler trace for the record
 timeout 900 python -m dlaf_tpu.miniapp.miniapp_eigensolver --m 8192 --mb 512 \

@@ -1,0 +1,298 @@
+"""Idle-replica shadow sweeps — scheduling, preemption, profile folding.
+
+The :class:`plan.shadow.ShadowSweeper` is pure scheduling over injected
+effects, so the contract that matters in production — real work always
+wins, and waits behind at most the ONE in-flight micro-batch — is
+provable here with synthetic clocks and flags, no fleet required.  The
+fold half is exercised through ``Fleet._shadow_fold`` directly (the
+method only touches ``base_dir``/``profile_path``): sweep measurements
+land in ``harvested-profile.json`` with ``source='shadow_sweep'``
+provenance, the installed profile flips ``autotune.decide`` to
+``source='profile'``, and the change is audited as a ``plan``/
+``autotune_flip`` record.  The full idle-fleet loop runs in CI's
+serve-fleet lane (``scripts/shadow_smoke.py``).
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dlaf_tpu import tune
+from dlaf_tpu.health import ConfigurationError
+from dlaf_tpu.obs import metrics as om
+from dlaf_tpu.plan import autotune
+from dlaf_tpu.plan.shadow import ShadowSweeper
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _sweeper(clock, *, busy, measured, folded, geoms=("g0", "g1"),
+             idle_s=10.0, cooldown_s=5.0, seconds=0.01, **kw):
+    def measure(g):
+        measured.append(g)
+        return seconds
+
+    return ShadowSweeper(
+        busy_fn=lambda: busy[0], measure_fn=measure,
+        geometries_fn=lambda: list(geoms), fold_fn=folded.append,
+        idle_s=idle_s, cooldown_s=cooldown_s, now_fn=clock,
+        background=False, **kw,
+    )
+
+
+# ----------------------------------------------------------- scheduling
+
+
+def test_tick_state_machine_and_rearm():
+    clock, busy = _Clock(), [True]
+    measured, folded = [], []
+    sw = _sweeper(clock, busy=busy, measured=measured, folded=folded,
+                  cooldown_s=30.0)
+    assert sw.tick() == "busy"
+    busy[0] = False
+    assert sw.tick() == "arming"  # idle clock starts now
+    clock.t = 9.0
+    assert sw.tick() == "arming"
+    clock.t = 10.0
+    assert sw.tick() == "started"
+    assert sw.sweeps == 1 and sw.measured == 2 and sw.aborted == 0
+    assert folded == [[("g0", 0.01), ("g1", 0.01)]]
+    # idleness re-arms after a sweep: a permanently idle fleet does not
+    # sweep back-to-back
+    assert sw.tick() == "arming"
+    clock.t = 25.0  # idle long enough, but inside cooldown_s=30 of t=10
+    assert sw.tick() == "cooldown"
+    clock.t = 45.0
+    assert sw.tick() == "started"
+    assert sw.sweeps == 2
+
+
+def test_busy_resets_idle_clock():
+    clock, busy = _Clock(), [False]
+    measured, folded = [], []
+    sw = _sweeper(clock, busy=busy, measured=measured, folded=folded)
+    assert sw.tick() == "arming"
+    clock.t = 9.0
+    busy[0] = True
+    assert sw.tick() == "busy"  # a blip at t=9 discards the armed window
+    busy[0] = False
+    clock.t = 12.0
+    assert sw.tick() == "arming"  # needs a FRESH idle_s from here
+    clock.t = 21.9
+    assert sw.tick() == "arming"
+    clock.t = 22.0
+    assert sw.tick() == "started"
+    assert not measured == []
+
+
+def test_max_geometries_caps_sweep():
+    clock, busy = _Clock(), [False]
+    measured, folded = [], []
+    sw = _sweeper(clock, busy=busy, measured=measured, folded=folded,
+                  geoms=list(range(10)), max_geometries=3)
+    sw.tick()
+    clock.t = 10.0
+    assert sw.tick() == "started"
+    assert measured == [0, 1, 2]
+
+
+# ----------------------------------------------------------- preemption
+
+
+def test_real_work_preempts_within_one_batch():
+    """Work arriving WHILE a micro-batch runs: the batch in flight
+    finishes, every later geometry is skipped — real work waits behind at
+    most one measurement."""
+    clock, busy = _Clock(), [False]
+    folded, measured = [], []
+
+    def measure(g):
+        measured.append(g)
+        busy[0] = True  # traffic lands mid-measurement
+        return 0.01
+
+    sw = ShadowSweeper(
+        busy_fn=lambda: busy[0], measure_fn=measure,
+        geometries_fn=lambda: ["g0", "g1", "g2", "g3"],
+        fold_fn=folded.append, idle_s=10.0, now_fn=clock, background=False,
+    )
+    sw.tick()
+    clock.t = 10.0
+    assert sw.tick() == "started"
+    assert measured == ["g0"] and sw.aborted == 1
+    # the one completed measurement still folds — it cost real time
+    assert folded == [[("g0", 0.01)]]
+
+
+def test_tick_aborts_background_sweep():
+    """The monitor thread's tick() during a background sweep: 'busy' is
+    returned immediately and the running sweep stops after the in-flight
+    measurement."""
+    busy = [False]
+    entered, gate = threading.Event(), threading.Event()
+    folded, measured = [], []
+
+    def measure(g):
+        measured.append(g)
+        entered.set()
+        assert gate.wait(10.0)
+        return 0.01
+
+    sw = ShadowSweeper(
+        busy_fn=lambda: busy[0], measure_fn=measure,
+        geometries_fn=lambda: ["g0", "g1", "g2"], fold_fn=folded.append,
+        idle_s=0.0, background=True,
+    )
+    assert sw.tick() == "arming"
+    assert sw.tick() == "started"
+    assert entered.wait(10.0)
+    busy[0] = True
+    assert sw.tick() == "busy"  # sets the abort flag
+    gate.set()
+    deadline = time.monotonic() + 10.0
+    while sw.sweeping() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not sw.sweeping()
+    assert measured == ["g0"] and sw.aborted == 1
+
+
+# ------------------------------------------------------- fault isolation
+
+
+def test_measure_error_ends_sweep_without_propagating():
+    clock, busy = _Clock(), [False]
+    folded = []
+
+    def measure(g):
+        raise RuntimeError("replica went away")
+
+    sw = ShadowSweeper(
+        busy_fn=lambda: busy[0], measure_fn=measure,
+        geometries_fn=lambda: ["g0", "g1"], fold_fn=folded.append,
+        idle_s=0.0, now_fn=clock, background=False,
+    )
+    sw.tick()
+    clock.t = 1.0
+    assert sw.tick() == "started"  # no exception escapes into the monitor
+    assert sw.aborted == 1 and sw.measured == 0 and folded == []
+
+
+def test_geometries_error_is_safe():
+    clock, busy = _Clock(), [False]
+    folded = []
+    sw = ShadowSweeper(
+        busy_fn=lambda: busy[0],
+        measure_fn=lambda g: 0.01,
+        geometries_fn=lambda: (_ for _ in ()).throw(ValueError("bad mix")),
+        fold_fn=folded.append, idle_s=0.0, now_fn=clock, background=False,
+    )
+    sw.tick()
+    clock.t = 1.0
+    assert sw.tick() == "started"
+    assert sw.sweeps == 1 and sw.measured == 0 and folded == []
+
+
+def test_fold_error_is_safe():
+    clock, busy = _Clock(), [False]
+
+    def fold(results):
+        raise OSError("disk full")
+
+    sw = ShadowSweeper(
+        busy_fn=lambda: busy[0], measure_fn=lambda g: 0.01,
+        geometries_fn=lambda: ["g0"], fold_fn=fold,
+        idle_s=0.0, now_fn=clock, background=False,
+    )
+    sw.tick()
+    clock.t = 1.0
+    assert sw.tick() == "started"
+    assert sw.measured == 1  # measurement happened; only the fold failed
+
+
+# ------------------------------------------------------------------ knob
+
+
+def test_shadow_idle_knob_domain():
+    tune.validate_telemetry_knob("telemetry_shadow_idle_s", 0)  # 0 disables
+    tune.validate_telemetry_knob("telemetry_shadow_idle_s", 2.5)
+    with pytest.raises(ConfigurationError):
+        tune.validate_telemetry_knob("telemetry_shadow_idle_s", -1)
+    with pytest.raises(ConfigurationError):
+        tune.validate_telemetry_knob("telemetry_shadow_idle_s", "soon")
+
+
+def test_shadow_idle_knob_update_roundtrip():
+    p = tune.get_tune_parameters()
+    old = p.telemetry_shadow_idle_s
+    try:
+        p.update(telemetry_shadow_idle_s=3.5)
+        assert tune.get_tune_parameters().telemetry_shadow_idle_s == 3.5
+        with pytest.raises(ConfigurationError):
+            p.update(telemetry_shadow_idle_s=-2)
+    finally:
+        p.update(telemetry_shadow_idle_s=old)
+
+
+# ------------------------------------------------------------------ fold
+
+
+def test_shadow_fold_writes_profile_and_flips_decide(tmp_path):
+    """Sweep results land in harvested-profile.json with shadow_sweep
+    provenance; the installed profile flips decide() to source='profile'
+    and the flip is audited as a plan/autotune_flip record."""
+    from dlaf_tpu.serve.fleet import Fleet
+
+    fl = Fleet.__new__(Fleet)  # fold only touches base_dir/profile_path
+    fl.base_dir = str(tmp_path)
+    fl.profile_path = None
+    geom = ("potrf", 64, "<f4")
+    stream = str(tmp_path / "metrics.jsonl")
+    om.enable(stream)
+    try:
+        autotune.load_profile("")  # start from the analytic model
+        assert autotune.decide(*geom).source == "analytic"
+        Fleet._shadow_fold(fl, [(geom, 0.012), (geom, 0.010)])
+        path = os.path.join(str(tmp_path), "harvested-profile.json")
+        assert fl.profile_path == path
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["schema"] == autotune.PROFILE_SCHEMA
+        assert doc["harvest"]["source"] == "shadow_sweep"
+        (entry,) = doc["entries"]
+        assert entry["op"] == "potrf" and entry["n"] == 64
+        assert entry["source"] == "shadow_sweep"
+        assert entry["trailing_update_impl"] in ("xla", "fused")
+        assert entry["measured"]["batches"] == 2
+        assert entry["measured"]["mean_batch_s"] == pytest.approx(0.011)
+        assert autotune.decide(*geom).source == "profile"
+        om.close()
+        flips = [r for r in om.read_jsonl(stream)
+                 if r.get("event") == "autotune_flip"]
+        assert len(flips) == 1
+        assert flips[0]["before"] == "analytic"
+        assert flips[0]["after"] == "profile"
+        assert flips[0]["op"] == "potrf" and flips[0]["n"] == 64
+        # folding again UPSERTS the same geometry (no duplicate entries),
+        # and the already-profiled decide answer does not re-flip
+        om.enable(stream)
+        Fleet._shadow_fold(fl, [(geom, 0.014)])
+        om.close()
+        with open(path) as fh:
+            doc2 = json.load(fh)
+        (entry2,) = doc2["entries"]
+        assert entry2["measured"]["batches"] == 3
+        assert doc2["harvest"]["shadow_sweeps"] == 2
+        flips2 = [r for r in om.read_jsonl(stream)
+                  if r.get("event") == "autotune_flip"]
+        assert flips2 == []
+    finally:
+        om.close()
+        autotune.load_profile("")

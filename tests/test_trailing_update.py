@@ -320,6 +320,192 @@ def test_posv_fused_vs_xla(grid_2x4, dtype):
     np.testing.assert_array_equal(ref, out)
 
 
+# ------------------------------------------- the one-shot contract kernel
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_panel_contract_bit_parity(dtype):
+    """The TRTRI fused path's one-shot kernel IS contract(...): bit-equal
+    to the tile-level einsum (its ``ijab,jbc->iac`` sums over panel slots,
+    so it must NOT be consumed per hop — this kernel is the alternative)."""
+    xs = _rand((3, 4, 8, 8), dtype, seed=61)
+    rp = _rand((4, 8, 8), dtype, seed=67)
+    ref = np.asarray(jax.jit(
+        lambda a, b: t.contract("ijab,jbc->iac", a, b)
+    )(xs, rp))
+    out = np.asarray(ptu.panel_contract(xs, rp, "ijab,jbc->iac"))
+    np.testing.assert_array_equal(ref, out)
+    # and the upper mirror's subscripts (consumed operand first)
+    cp = _rand((3, 8, 8), dtype, seed=69)
+    ref2 = np.asarray(jax.jit(
+        lambda a, b: t.contract("iab,ijbc->jac", a, b)
+    )(cp, xs))
+    out2 = np.asarray(ptu.panel_contract(cp, xs, "iab,ijbc->jac"))
+    np.testing.assert_array_equal(ref2, out2)
+
+
+def test_panel_contract_signed_zero():
+    """Why the fused TRTRI uses panel_contract and not trailing_update on a
+    zero accumulator: ``0.0 - x`` flips the sign of signed zeros where the
+    caller's ``-contract`` (on the identical bits) does not."""
+    a = np.zeros((1, 1, 2, 2), np.float32)
+    b = np.zeros((1, 2, 2), np.float32)
+    out = np.asarray(ptu.panel_contract(a, b, "ijab,jbc->iac"))
+    assert not np.signbit(out).any()
+
+
+# --------------------------------------- the new consumers: parity e2e
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_gen_to_std_fused_vs_xla(comm_grids, shape, dtype):
+    """The her2k window consumer: fused hegst phase A under the fused tier
+    is bit-identical to the XLA tier (two consume rings per step, one per
+    two-sided addend, suppressed left of the panel)."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+
+    grid = _grid(comm_grids, shape)
+    a = tu.random_hermitian_pd(40, dtype, seed=71)
+    b = tu.random_hermitian_pd(40, dtype, seed=73)
+    l = np.tril(sla.cholesky(b, lower=True)).astype(dtype)
+
+    def run():
+        ma = DistributedMatrix.from_global(grid, a, (8, 8))
+        mb = DistributedMatrix.from_global(grid, l, (8, 8))
+        return generalized_to_standard("L", ma, mb).to_global()
+
+    with _knobs(gen_to_std_backend="fused"):
+        with _knobs(trailing_update_impl="xla"):
+            ref = run()
+        with _knobs(trailing_update_impl="fused"):
+            out = run()
+    np.testing.assert_array_equal(ref, out)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_trtri_fused_vs_xla(comm_grids, shape, dtype, uplo):
+    """The TRTRI column/row-update consumer: consume-ring transport plus
+    the one-shot panel_contract kernel, bit-identical to the XLA tier on
+    both triangles."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.algorithms.inverse import triangular_inverse
+
+    grid = _grid(comm_grids, shape)
+    b = tu.random_hermitian_pd(40, dtype, seed=79)
+    f = sla.cholesky(b, lower=(uplo == "L")).astype(dtype)
+    f = np.tril(f) if uplo == "L" else np.triu(f)
+
+    def run():
+        m = DistributedMatrix.from_global(grid, f, (8, 8))
+        return triangular_inverse(uplo, "N", m).to_global()
+
+    with _knobs(trailing_update_impl="xla"):
+        ref = run()
+    with _knobs(trailing_update_impl="fused"):
+        out = run()
+    np.testing.assert_array_equal(ref, out)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_red2band_fused_vs_xla(comm_grids, shape, dtype):
+    """The red2band two-sided consumer: W2 addend applied by the one-shot
+    kernel, the diagonal-crossing V addend consumed out of the ring —
+    matrix AND taus bit-identical to the XLA tier."""
+    from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+
+    grid = _grid(comm_grids, shape)
+    a = tu.random_hermitian_pd(48, dtype, seed=83)
+
+    def run():
+        m = DistributedMatrix.from_global(grid, a, (8, 8))
+        out, taus = reduction_to_band(m, band=4)
+        return np.asarray(out.to_global()), np.asarray(taus)
+
+    with _knobs(trailing_update_impl="xla"):
+        ref_m, ref_t = run()
+    with _knobs(trailing_update_impl="fused"):
+        out_m, out_t = run()
+    np.testing.assert_array_equal(ref_m, out_m)
+    np.testing.assert_array_equal(ref_t, out_t)
+
+
+def test_her2k_suppress_mask_edge(comm_grids):
+    """The two-sided her2k suppress edge, both halves.
+
+    (a) The invariant the suppression RELIES on: under the xla tier the
+    exchanged her2k panels are exactly zero at window slots ``jv <= k``
+    (the below-mask zeroed them before the bcast), so zeroing them in the
+    fused tier is bitwise identity.  (b) The machinery itself: a poisoned
+    suppressed slot must not perturb the trailing matrix, while the
+    returned merged panel still carries its bytes (the narrow-update
+    contract)."""
+    grid = _grid(comm_grids, (2, 4))
+    # (a) tiny clamped geometry: mt=3 on 2x4 forces windows whose clamped
+    # slots sit at or left of the panel — exactly the suppressed set
+    import scipy.linalg as sla
+
+    from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+
+    a = tu.random_hermitian_pd(24, np.float32, seed=89)
+    b = tu.random_hermitian_pd(24, np.float32, seed=97)
+    l = np.tril(sla.cholesky(b, lower=True)).astype(np.float32)
+
+    def run():
+        ma = DistributedMatrix.from_global(grid, a, (8, 8))
+        mb = DistributedMatrix.from_global(grid, l, (8, 8))
+        return generalized_to_standard("L", ma, mb).to_global()
+
+    with _knobs(gen_to_std_backend="fused"):
+        with _knobs(trailing_update_impl="xla"):
+            ref = run()
+        with _knobs(trailing_update_impl="fused"):
+            out = run()
+    np.testing.assert_array_equal(ref, out)
+
+    # (b) direct: suppressed-but-owned slot poisoned with huge garbage
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:2]), ("x",))
+    mb = 8
+    x = _rand((2, 3, 2, mb, mb), np.float32, seed=101)
+    cp = _rand((2, 3, mb, mb), np.float32, seed=103)
+    taken = _rand((2, 2, mb, mb), np.float32, seed=107)
+    taken[0, 0] = 1e30  # poisoned payload in the suppressed slot
+    have = np.array([[True, False], [False, True]])
+    suppress = np.array([[True, False], [True, False]])
+
+    def fn(xl, cpl, tl, hl, sl):
+        sq = lambda v: v.reshape(v.shape[1:])
+        ox, orp = ptu.fused_transpose_update(
+            sq(xl), sq(cpl), sq(tl), sq(hl), sq(sl), "x", mesh_axes=("x",)
+        )
+        return ox[None], orp[None]
+
+    f = jax.jit(coll.shard_map_compat(
+        fn, mesh=mesh, in_specs=(P("x"),) * 5, out_specs=(P("x"),) * 2
+    ))
+    ox, orp = (np.asarray(v) for v in f(x, cp, taken, have, suppress))
+    merged = np.stack([taken[0, 0], taken[1, 1]])
+    for r in range(2):
+        # the merged panel still ships the poisoned bytes...
+        np.testing.assert_array_equal(orp[r], merged)
+        # ...but the trailing update never read slot 0
+        contrib = np.where(
+            np.array([False, True]).reshape(2, 1, 1), merged, 0
+        )
+        want = np.asarray(jax.jit(
+            lambda x, a, b: x - t.contract(ptu.TRAILING_SUBSCRIPTS, a, b)
+        )(x[r], cp[r], contrib.conj()))
+        np.testing.assert_array_equal(ox[r], want)
+        assert np.isfinite(ox[r]).all()
+
+
 # ------------------------------------------------------- overlap accounting
 
 
@@ -349,6 +535,90 @@ def test_fused_overlap_fraction(grid_2x4):
     assert fused and all(
         r["overlapped_wire_bytes"] == r["modeled_wire_bytes"] for r in fused
     ), fused
+
+
+def _overlap_rows(acc, suffixes=("_pallas", "_fused")):
+    from dlaf_tpu.obs import comms as ocomms
+
+    rows = [r for r in ocomms.as_records(acc)
+            if r["collective"].endswith(suffixes)]
+    tot = sum(r["modeled_wire_bytes"] for r in rows)
+    ov = sum(r["overlapped_wire_bytes"] for r in rows)
+    return rows, tot, ov
+
+
+def test_gen_to_std_fused_overlap_fraction(grid_2x4):
+    """The her2k consumer's acceptance bound: >=70%% of the fused hegst's
+    modeled panel-exchange wire bytes classify overlapped.  Needs a
+    geometry where panel traffic (quadratic in tiles) dominates the
+    diag-tile bcasts (linear), and trsm lookahead on so phase B's panels
+    are consumed too — mt=24 measures 72%%."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.algorithms.gen_to_std import generalized_to_standard
+    from dlaf_tpu.obs import comms as ocomms
+
+    a = tu.random_hermitian_pd(192, np.float32, seed=109)
+    b = tu.random_hermitian_pd(192, np.float32, seed=113)
+    l = np.tril(sla.cholesky(b, lower=True)).astype(np.float32)
+    with _knobs(collectives_impl="pallas", trailing_update_impl="fused",
+                gen_to_std_backend="fused", trsm_lookahead=True):
+        ocomms.start()
+        ma = DistributedMatrix.from_global(grid_2x4, a, (8, 8))
+        mb = DistributedMatrix.from_global(grid_2x4, l, (8, 8))
+        generalized_to_standard("L", ma, mb).data.block_until_ready()
+        acc = ocomms.stop()
+    rows, tot, ov = _overlap_rows(acc)
+    assert tot > 0, rows
+    assert ov >= 0.7 * tot, (ov, tot, rows)
+
+
+def test_trtri_fused_overlap_fraction(grid_2x4):
+    """The TRTRI consumer's acceptance bound (83%% measured at mt=16: the
+    consumed panel bcast + consume-ring transport dominate; the s_full
+    psum reduction is not panel-exchange traffic and is excluded by the
+    pallas/fused row filter)."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.algorithms.inverse import triangular_inverse
+    from dlaf_tpu.obs import comms as ocomms
+
+    b = tu.random_hermitian_pd(128, np.float32, seed=127)
+    l = np.tril(sla.cholesky(b, lower=True)).astype(np.float32)
+    with _knobs(collectives_impl="pallas", trailing_update_impl="fused"):
+        ocomms.start()
+        m = DistributedMatrix.from_global(grid_2x4, l, (8, 8))
+        triangular_inverse("L", "N", m).data.block_until_ready()
+        acc = ocomms.stop()
+    rows, tot, ov = _overlap_rows(acc)
+    assert tot > 0, rows
+    assert ov >= 0.7 * tot, (ov, tot, rows)
+
+
+def test_red2band_fused_overlap_fraction(grid_2x4):
+    """red2band's panel-EXCHANGE bytes (the transpose_panel family) are
+    fully overlapped under the fused tier.  Scoped to that family: the
+    op's wire profile is dominated by the O(N band) column-strip gather
+    feeding the redundant Householder panel — a broadcast consumed by
+    panel factorization on every rank, not a trailing-update panel
+    exchange, and out of scope for the consume ring by construction."""
+    from dlaf_tpu.algorithms.reduction_to_band import reduction_to_band
+    from dlaf_tpu.obs import comms as ocomms
+
+    a = tu.random_hermitian_pd(128, np.float32, seed=131)
+    with _knobs(collectives_impl="pallas", trailing_update_impl="fused"):
+        ocomms.start()
+        m = DistributedMatrix.from_global(grid_2x4, a, (8, 8))
+        out, _ = reduction_to_band(m, band=8)
+        out.data.block_until_ready()
+        acc = ocomms.stop()
+    rows = [r for r in ocomms.as_records(acc)
+            if r["collective"].startswith("transpose_panel")]
+    tot = sum(r["modeled_wire_bytes"] for r in rows)
+    ov = sum(r["overlapped_wire_bytes"] for r in rows)
+    assert tot > 0, rows
+    assert ov == tot, (ov, tot, rows)
+    assert all(r["collective"] == "transpose_panel_fused" for r in rows)
 
 
 # ------------------------------------------------------ validation / policy
